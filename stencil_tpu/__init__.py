@@ -1,0 +1,41 @@
+"""stencil_tpu — a TPU-native 3D stencil halo-exchange framework.
+
+Built from scratch in JAX/XLA/Pallas with the capabilities of the reference
+MPI+CUDA library (``/root/reference``, mengshanfeng/stencil-2).  The reference's
+five hand-rolled transports collapse into ``lax.ppermute`` collectives over a
+3D device mesh; its CUDA pack/unpack kernels become Pallas kernels; its
+double-buffered device allocations become donated, shell-carrying sharded
+``jax.Array`` s.
+
+Public API (mirrors reference ``include/stencil/stencil.hpp``):
+
+    from stencil_tpu import DistributedDomain, Radius, Dim3, MethodFlags
+"""
+
+from stencil_tpu.core.dim3 import Dim3, Rect3
+from stencil_tpu.core.direction_map import DirectionMap, DIRECTIONS_26
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.core.geometry import LocalSpec
+from stencil_tpu.utils.config import MethodFlags
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dim3",
+    "Rect3",
+    "DirectionMap",
+    "DIRECTIONS_26",
+    "Radius",
+    "LocalSpec",
+    "MethodFlags",
+    "DistributedDomain",
+]
+
+
+def __getattr__(name):
+    # DistributedDomain pulls in jax; keep the geometry core importable without it.
+    if name == "DistributedDomain":
+        from stencil_tpu.domain import DistributedDomain
+
+        return DistributedDomain
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,13 @@
+"""Foundation geometry types (reference layer L0, ``include/stencil/``).
+
+Pure Python, no JAX dependency — importable everywhere, including host-side
+planning code and unit tests that never touch a device.
+"""
+
+from stencil_tpu.core.dim3 import Dim3, Rect3
+from stencil_tpu.core.direction_map import DirectionMap, DIRECTIONS_26
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.core.geometry import LocalSpec
+from stencil_tpu.core.accessor import Accessor
+
+__all__ = ["Dim3", "Rect3", "DirectionMap", "DIRECTIONS_26", "Radius", "LocalSpec", "Accessor"]

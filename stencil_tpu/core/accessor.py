@@ -1,0 +1,54 @@
+"""Global-coordinate views over a halo-carrying local block.
+
+Parity target: ``Accessor<T>`` (reference include/stencil/accessor.hpp:13-45),
+which lets stencil kernels index by global 3D point, oblivious to halo
+offsets.  On TPU the idiomatic analog is *shifted slicing*: a stencil term
+``src[o + (dx,dy,dz)]`` over the whole compute region is the interior-sized
+slice of the shell-carrying block offset by ``(dx,dy,dz)``.  XLA fuses the
+shifted slices into one vectorized loop — this is the stencil-kernel writing
+surface of the framework.
+
+``Accessor`` works on anything sliceable with numpy basic indexing (numpy
+arrays and jax arrays alike), so the same user kernel runs in tests (numpy),
+under ``jit`` (traced jax), and inside ``shard_map`` (per-shard blocks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from stencil_tpu.core.dim3 import Dim3, Rect3
+
+
+@dataclasses.dataclass(frozen=True)
+class Accessor:
+    """View of a raw (shell-carrying) block addressed in global coordinates.
+
+    ``raw`` has extent ``spec.raw_size()`` with index order (x, y, z);
+    ``origin`` is the global coordinate of the first *interior* point;
+    ``lo_off`` is the shell width on the negative side per axis (so global
+    point ``p`` lives at raw index ``p - origin + lo_off``).
+    """
+
+    raw: Any
+    origin: Dim3
+    lo_off: Dim3
+
+    def __getitem__(self, p) -> Any:
+        """Scalar read at a global point (accessor.hpp:27-40)."""
+        p = Dim3.of(p)
+        i = p - self.origin + self.lo_off
+        return self.raw[i.x, i.y, i.z]
+
+    def region(self, r: Rect3) -> Any:
+        """Slice a global-coords region out of the raw block."""
+        lo = r.lo - self.origin + self.lo_off
+        hi = r.hi - self.origin + self.lo_off
+        return self.raw[lo.x : hi.x, lo.y : hi.y, lo.z : hi.z]
+
+    def shifted(self, region: Rect3, d) -> Any:
+        """``src[o + d]`` for every ``o`` in ``region`` — the stencil-term
+        primitive.  Returns an array of ``region.extent()`` shape."""
+        d = Dim3.of(d)
+        return self.region(Rect3(region.lo + d, region.hi + d))

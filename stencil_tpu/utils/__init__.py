@@ -1,0 +1,6 @@
+"""Utilities: config flags, leveled logging, statistics, phase timers."""
+
+from stencil_tpu.utils.config import MethodFlags, PlacementStrategy
+from stencil_tpu.utils.statistics import Statistics
+
+__all__ = ["MethodFlags", "PlacementStrategy", "Statistics"]

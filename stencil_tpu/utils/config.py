@@ -1,0 +1,49 @@
+"""Runtime configuration enums.
+
+Parity target: ``MethodFlags`` (reference include/stencil/stencil.hpp:29-41)
+and ``PlacementStrategy`` (partition.hpp:312).  On TPU the five transports
+collapse into XLA collectives, so the method flags select the *exchange
+implementation* used by ``DistributedDomain.exchange`` — primarily for
+benchmarking alternatives, exactly the role the reference's flags play:
+
+* ``Ppermute``   — 3-axis-sweep ``lax.ppermute`` inside ``shard_map`` (the
+                   production path; subsumes CudaMpi / CudaAwareMpi /
+                   CudaMpiColocated / CudaMemcpyPeer / CudaKernel).
+* ``AllGather``  — debug path: all-gather the global field and re-slice
+                   (obviously slow; validates the ppermute path).
+* ``RollCompare`` — host/debug: exchange implied by ``jnp.roll`` on the
+                   gathered global array (test oracle).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class MethodFlags(enum.Flag):
+    Non = 0
+    # TPU-native methods
+    Ppermute = enum.auto()
+    AllGather = enum.auto()
+    RollCompare = enum.auto()
+    # Reference-compat aliases (stencil.hpp:29-41): all map onto the collective
+    # path; accepted so reference-style driver flags keep working.
+    CudaMpi = Ppermute
+    CudaAwareMpi = Ppermute
+    CudaMpiColocated = Ppermute
+    CudaMemcpyPeer = Ppermute
+    CudaKernel = Ppermute
+    # Reference All (stencil.hpp:36-40) is the production-transport set — all
+    # of which collapse to the collective path here; the debug AllGather
+    # method is opt-in only.
+    All = Ppermute
+
+    def and_(self, o: "MethodFlags") -> bool:
+        return bool(self & o)
+
+
+class PlacementStrategy(enum.Enum):
+    """partition.hpp:312 — NodeAware maps to torus-aware mesh axis ordering."""
+
+    NodeAware = 0
+    Trivial = 1

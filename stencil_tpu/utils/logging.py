@@ -1,0 +1,62 @@
+"""Leveled, rank-tagged logging.
+
+Parity target: reference include/stencil/logging.hpp:12-53 — SPEW/DEBUG/INFO/
+WARN/ERROR/FATAL macros, each line tagged ``[file:line](rank)``, filtered by a
+compile-time level.  Here the level comes from ``STENCIL_OUTPUT_LEVEL`` (same
+name as the reference's CMake option, CMakeLists.txt:22-27): 0=SPEW .. 5=FATAL,
+default 3 (WARN and up), read once at import.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+SPEW, DEBUG, INFO, WARN, ERROR, FATAL = range(6)
+_NAMES = ["SPEW", "DEBUG", "INFO", "WARN", "ERROR", "FATAL"]
+
+_LEVEL = int(os.environ.get("STENCIL_OUTPUT_LEVEL", "3"))
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _emit(level: int, msg: str) -> None:
+    if level < _LEVEL:
+        return
+    f = sys._getframe(2)
+    tag = f"[{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}]({_rank()})"
+    print(f"{_NAMES[level]} {tag} {msg}", file=sys.stderr)
+
+
+def log_spew(msg: str) -> None:
+    _emit(SPEW, msg)
+
+
+def log_debug(msg: str) -> None:
+    _emit(DEBUG, msg)
+
+
+def log_info(msg: str) -> None:
+    _emit(INFO, msg)
+
+
+def log_warn(msg: str) -> None:
+    _emit(WARN, msg)
+
+
+def log_error(msg: str) -> None:
+    _emit(ERROR, msg)
+
+
+def log_fatal(msg: str) -> None:
+    """Unlike the reference's exit(1) (logging.hpp:47-50), raise — a Python
+    framework should unwind, not kill the interpreter under the user."""
+    _emit(FATAL, msg)
+    raise RuntimeError(msg)

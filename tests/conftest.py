@@ -1,0 +1,15 @@
+"""Test configuration: fake an 8-chip mesh on CPU.
+
+Mirrors the reference's "fake cluster" trick (test_exchange.cu:57 forces two
+subdomains onto one GPU): here we force the host platform to expose 8 virtual
+devices so mesh/sharding tests run anywhere (SURVEY.md §4 port note).  Must be
+set before jax initializes its backends.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,71 @@
+"""Tier-1 units for Dim3/Rect3 (reference dim3.hpp / rect3.hpp semantics)."""
+
+from stencil_tpu.core.dim3 import Dim3, Rect3, euclid_dist
+
+
+def test_arithmetic():
+    a = Dim3(1, 2, 3)
+    b = Dim3(4, 5, 6)
+    assert a + b == Dim3(5, 7, 9)
+    assert b - a == Dim3(3, 3, 3)
+    assert a * b == Dim3(4, 10, 18)
+    assert b // 2 == Dim3(2, 2, 3)
+    assert b % 2 == Dim3(0, 1, 0)
+    assert -a == Dim3(-1, -2, -3)
+    assert a + 1 == Dim3(2, 3, 4)
+    assert a * -1 == Dim3(-1, -2, -3)
+
+
+def test_lexicographic_order_x_most_significant():
+    # dim3.hpp:78-92: x, then y, then z
+    assert Dim3(0, 9, 9) < Dim3(1, 0, 0)
+    assert Dim3(0, 0, 9) < Dim3(0, 1, 0)
+    assert Dim3(0, 0, 0) < Dim3(0, 0, 1)
+    assert not Dim3(1, 0, 0) < Dim3(1, 0, 0)
+    assert sorted([Dim3(0, 0, 1), Dim3(1, 0, 0), Dim3(0, 1, 0)]) == [
+        Dim3(0, 0, 1),
+        Dim3(0, 1, 0),
+        Dim3(1, 0, 0),
+    ]
+
+
+def test_flatten_and_wrap():
+    assert Dim3(3, 4, 5).flatten() == 60
+    lims = Dim3(10, 10, 10)
+    # dim3.hpp:216-231: one period out of range on either side
+    assert Dim3(-1, 0, 10).wrap(lims) == Dim3(9, 0, 0)
+    assert Dim3(10, -1, 5).wrap(lims) == Dim3(0, 9, 5)
+    assert Dim3(3, 4, 5).wrap(lims) == Dim3(3, 4, 5)
+
+
+def test_predicates():
+    assert Dim3(1, 1, 1).all_gt(0)
+    assert not Dim3(1, 0, 1).all_gt(0)
+    assert Dim3(1, 0, 1).any_lt(1)
+    assert Dim3(2, 2, 2).all_lt(3)
+
+
+def test_next_power_of_two():
+    assert Dim3.next_power_of_two(1) == 1
+    assert Dim3.next_power_of_two(2) == 2
+    assert Dim3.next_power_of_two(3) == 4
+    assert Dim3.next_power_of_two(5) == 8
+    assert Dim3.next_power_of_two(0) == 0
+
+
+def test_rect3():
+    r = Rect3(Dim3(1, 2, 3), Dim3(4, 6, 8))
+    assert r.extent() == Dim3(3, 4, 5)
+    assert r.contains(Dim3(1, 2, 3))
+    assert not r.contains(Dim3(4, 2, 3))
+    assert len(list(r.points())) == 60
+
+
+def test_euclid_dist():
+    assert euclid_dist(Dim3(0, 0, 0), Dim3(3, 4, 0)) == 5
+    assert euclid_dist(Dim3(0, 0, 0), Dim3(1, 1, 1)) == 1  # truncated sqrt(3)
+
+
+def test_hashable_dict_key():
+    d = {Dim3(1, 0, 0): "px", Dim3(-1, 0, 0): "mx"}
+    assert d[Dim3(1, 0, 0)] == "px"

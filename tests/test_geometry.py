@@ -1,0 +1,185 @@
+"""Tier-1 units for LocalSpec halo geometry.
+
+Mirrors reference test/test_cuda_local_domain.cu: all 26 directions' pos/extent
+for symmetric radius 4 (30x40x50 domain) and an x-leaning radius {+x:3}, plus
+the `-dir` message-extent invariant ("case1", test_cuda_local_domain.cu:5-17)
+and the interior/exterior split (src/stencil.cu:567-666).
+"""
+
+import numpy as np
+import pytest
+
+from stencil_tpu.core.dim3 import Dim3, Rect3
+from stencil_tpu.core.direction_map import DIRECTIONS_26
+from stencil_tpu.core.geometry import LocalSpec, exchange_bytes, ripple_field, ripple_value
+from stencil_tpu.core.radius import Radius
+
+
+def test_case1_message_extent_convention():
+    # test_cuda_local_domain.cu:5-17: +x send is the size of the -x side halo
+    r = Radius.constant(0)
+    r.set_dir(Dim3(1, 0, 0), 2)
+    r.set_dir(Dim3(-1, 0, 0), 1)
+    spec = LocalSpec.make((3, 4, 5), (0, 0, 0), r)
+    assert spec.halo_extent(Dim3(1, 0, 0) * -1) == Dim3(1, 4, 5)
+
+
+@pytest.fixture
+def sym4():
+    return LocalSpec.make((30, 40, 50), (0, 0, 0), Radius.constant(4))
+
+
+def test_face_pos_halo(sym4):
+    assert sym4.halo_pos(Dim3(-1, 0, 0), True) == Dim3(0, 4, 4)
+    assert sym4.halo_pos(Dim3(1, 0, 0), True) == Dim3(34, 4, 4)
+    assert sym4.halo_pos(Dim3(0, -1, 0), True) == Dim3(4, 0, 4)
+    assert sym4.halo_pos(Dim3(0, 1, 0), True) == Dim3(4, 44, 4)
+    assert sym4.halo_pos(Dim3(0, 0, -1), True) == Dim3(4, 4, 0)
+    assert sym4.halo_pos(Dim3(0, 0, 1), True) == Dim3(4, 4, 54)
+
+
+def test_face_pos_compute(sym4):
+    assert sym4.halo_pos(Dim3(-1, 0, 0), False) == Dim3(4, 4, 4)
+    assert sym4.halo_pos(Dim3(1, 0, 0), False) == Dim3(30, 4, 4)
+    assert sym4.halo_pos(Dim3(0, -1, 0), False) == Dim3(4, 4, 4)
+    assert sym4.halo_pos(Dim3(0, 1, 0), False) == Dim3(4, 40, 4)
+    assert sym4.halo_pos(Dim3(0, 0, -1), False) == Dim3(4, 4, 4)
+    assert sym4.halo_pos(Dim3(0, 0, 1), False) == Dim3(4, 4, 50)
+
+
+def test_face_extent(sym4):
+    assert sym4.halo_extent(Dim3(-1, 0, 0)) == Dim3(4, 40, 50)
+    assert sym4.halo_extent(Dim3(0, -1, 0)) == Dim3(30, 4, 50)
+    assert sym4.halo_extent(Dim3(0, 0, -1)) == Dim3(30, 40, 4)
+
+
+def test_edge_pos_halo(sym4):
+    assert sym4.halo_pos(Dim3(-1, -1, 0), True) == Dim3(0, 0, 4)
+    assert sym4.halo_pos(Dim3(1, -1, 0), True) == Dim3(34, 0, 4)
+    assert sym4.halo_pos(Dim3(-1, 1, 0), True) == Dim3(0, 44, 4)
+    assert sym4.halo_pos(Dim3(1, 1, 0), True) == Dim3(34, 44, 4)
+    assert sym4.halo_pos(Dim3(-1, 0, -1), True) == Dim3(0, 4, 0)
+    assert sym4.halo_pos(Dim3(1, 0, 1), True) == Dim3(34, 4, 54)
+    assert sym4.halo_pos(Dim3(0, -1, -1), True) == Dim3(4, 0, 0)
+    assert sym4.halo_pos(Dim3(0, 1, 1), True) == Dim3(4, 44, 54)
+
+
+def test_edge_pos_compute(sym4):
+    assert sym4.halo_pos(Dim3(-1, -1, 0), False) == Dim3(4, 4, 4)
+    assert sym4.halo_pos(Dim3(1, -1, 0), False) == Dim3(30, 4, 4)
+    assert sym4.halo_pos(Dim3(-1, 1, 0), False) == Dim3(4, 40, 4)
+    assert sym4.halo_pos(Dim3(1, 1, 0), False) == Dim3(30, 40, 4)
+    assert sym4.halo_pos(Dim3(0, 1, 1), False) == Dim3(4, 40, 50)
+
+
+def test_edge_extent(sym4):
+    assert sym4.halo_extent(Dim3(1, 1, 0)) == Dim3(4, 4, 50)
+    assert sym4.halo_extent(Dim3(1, 0, 1)) == Dim3(4, 40, 4)
+    assert sym4.halo_extent(Dim3(0, 1, 1)) == Dim3(30, 4, 4)
+
+
+def test_corner_pos(sym4):
+    assert sym4.halo_pos(Dim3(-1, -1, -1), True) == Dim3(0, 0, 0)
+    assert sym4.halo_pos(Dim3(1, 1, 1), True) == Dim3(34, 44, 54)
+    assert sym4.halo_pos(Dim3(1, -1, 1), True) == Dim3(34, 0, 54)
+    assert sym4.halo_pos(Dim3(-1, -1, -1), False) == Dim3(4, 4, 4)
+    assert sym4.halo_pos(Dim3(1, 1, 1), False) == Dim3(30, 40, 50)
+
+
+def test_corner_extent(sym4):
+    assert sym4.halo_extent(Dim3(1, 1, 1)) == Dim3(4, 4, 4)
+
+
+def test_raw_size(sym4):
+    assert sym4.raw_size() == Dim3(38, 48, 58)
+
+
+def test_x_leaning_radius():
+    r = Radius.constant(0)
+    r.set_dir(Dim3(1, 0, 0), 3)
+    spec = LocalSpec.make((30, 40, 50), (0, 0, 0), r)
+    assert spec.halo_pos(Dim3(-1, 0, 0), True) == Dim3(0, 0, 0)
+    assert spec.halo_pos(Dim3(1, 0, 0), True) == Dim3(30, 0, 0)
+    assert spec.halo_pos(Dim3(0, -1, 0), True) == Dim3(0, 0, 0)
+    assert spec.halo_pos(Dim3(0, 1, 0), True) == Dim3(0, 40, 0)
+    assert spec.halo_pos(Dim3(0, 0, -1), True) == Dim3(0, 0, 0)
+    assert spec.halo_pos(Dim3(0, 0, 1), True) == Dim3(0, 0, 50)
+    assert spec.halo_extent(Dim3(1, 0, 0)) == Dim3(3, 40, 50)
+    assert spec.halo_extent(Dim3(-1, 0, 0)) == Dim3(0, 40, 50)
+    assert spec.halo_extent(Dim3(0, 1, 0)) == Dim3(30, 0, 50)
+    assert spec.raw_size() == Dim3(33, 40, 50)
+
+
+def test_halo_coords_with_origin():
+    # src/local_domain.cu:14-32: translate alloc offsets to global coords
+    spec = LocalSpec.make((10, 10, 10), (20, 30, 40), Radius.constant(2))
+    c = spec.halo_coords(Dim3(1, 0, 0), halo=True)
+    assert c == Rect3(Dim3(30, 30, 40), Dim3(32, 40, 50))
+    c = spec.halo_coords(Dim3(-1, 0, 0), halo=False)
+    assert c == Rect3(Dim3(20, 30, 40), Dim3(22, 40, 50))
+    assert spec.compute_region() == Rect3(Dim3(20, 30, 40), Dim3(30, 40, 50))
+    assert spec.full_region() == Rect3(Dim3(18, 28, 38), Dim3(32, 42, 52))
+
+
+def test_interior_exterior_split():
+    spec = LocalSpec.make((10, 10, 10), (0, 0, 0), Radius.constant(2))
+    interior = spec.interior()
+    assert interior == Rect3(Dim3(2, 2, 2), Dim3(8, 8, 8))
+    ext = spec.exterior()
+    # slabs tile compute-minus-interior without overlap
+    total = sum(r.extent().flatten() for r in ext)
+    assert total == 10 ** 3 - 6 ** 3
+    seen = set()
+    for r in ext:
+        for p in r.points():
+            assert p not in seen
+            seen.add(p)
+            assert not interior.contains(p)
+            assert spec.compute_region().contains(p)
+
+
+def test_interior_exterior_uneven():
+    r = Radius.constant(0)
+    r.set_dir(Dim3(1, 0, 0), 2)
+    r.set_dir(Dim3(-1, 0, 0), 1)
+    spec = LocalSpec.make((10, 10, 10), (0, 0, 0), r)
+    interior = spec.interior()
+    assert interior == Rect3(Dim3(1, 0, 0), Dim3(8, 10, 10))
+    ext = spec.exterior()
+    total = sum(rr.extent().flatten() for rr in ext)
+    assert total == 10 ** 3 - 7 * 100
+
+
+def test_exchange_bytes_symmetric():
+    spec = LocalSpec.make((10, 10, 10), (0, 0, 0), Radius.constant(1))
+    # faces: 6*100, edges: 12*10, corners: 8*1 points, float32
+    assert exchange_bytes(spec, [4]) == 4 * (600 + 120 + 8)
+
+
+def test_edge_extent_uses_face_radii():
+    # local_domain.cuh:291-294: nonzero axes use radius.x(dir.x) — the FACE
+    # radius of that axis — not the full-direction radius
+    spec = LocalSpec.make((10, 10, 10), (0, 0, 0), Radius.face_edge_corner(2, 1, 1))
+    assert spec.halo_extent(Dim3(1, 1, 0)) == Dim3(2, 2, 10)
+    assert spec.halo_extent(Dim3(1, 1, 1)) == Dim3(2, 2, 2)
+
+
+def test_exchange_bytes_skips_zero_radius_dirs():
+    # src/stencil.cu:149: no message in dir d when radius.dir(-d)==0
+    spec = LocalSpec.make((10, 10, 10), (0, 0, 0), Radius.face_edge_corner(2, 0, 0))
+    # faces only: 6 * (2*10*10) points * 4 bytes; no edge/corner messages
+    assert exchange_bytes(spec, [4]) == 4 * 6 * 200
+
+
+def test_ripple_field_matches_scalar():
+    f = ripple_field(Dim3(3, 4, 5), Dim3(4, 4, 4))
+    for (i, j, k), v in np.ndenumerate(f):
+        assert v == pytest.approx(ripple_value(Dim3(3 + i, 4 + j, 5 + k)))
+
+
+def test_local_slices():
+    spec = LocalSpec.make((4, 4, 4), (8, 8, 8), Radius.constant(1))
+    sl = spec.interior_slices()
+    assert sl == (slice(1, 5), slice(1, 5), slice(1, 5))
+    raw = np.zeros(tuple(spec.raw_size()))
+    assert raw[sl].shape == (4, 4, 4)

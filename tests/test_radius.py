@@ -1,0 +1,76 @@
+"""Tier-1 units for Radius / DirectionMap (mirrors test_cpu_radius.cpp)."""
+
+import pytest
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.direction_map import (
+    CORNER_DIRECTIONS,
+    DIRECTIONS_26,
+    EDGE_DIRECTIONS,
+    FACE_DIRECTIONS,
+    DirectionMap,
+)
+from stencil_tpu.core.radius import Radius
+
+
+def test_direction_sets():
+    assert len(DIRECTIONS_26) == 26
+    assert len(FACE_DIRECTIONS) == 6
+    assert len(EDGE_DIRECTIONS) == 12
+    assert len(CORNER_DIRECTIONS) == 8
+    assert Dim3(0, 0, 0) not in DIRECTIONS_26
+
+
+def test_direction_map():
+    m = DirectionMap(0)
+    m[Dim3(1, 0, -1)] = 7
+    assert m.at_dir(1, 0, -1) == 7
+    assert m[Dim3(-1, 0, 1)] == 0
+    m2 = m.copy()
+    m2[Dim3(0, 0, 0)] = 1
+    assert m != m2
+
+
+def test_constant_factory():
+    r = Radius.constant(3)
+    for d in DIRECTIONS_26:
+        assert r.dir(d) == 3
+    assert r.x(1) == 3 and r.y(-1) == 3 and r.z(1) == 3
+
+
+def test_face_edge_corner_factory():
+    # radius.hpp:95-104
+    r = Radius.face_edge_corner(3, 2, 1)
+    assert r.dir(1, 0, 0) == 3
+    assert r.dir(0, -1, 0) == 3
+    assert r.dir(1, 1, 0) == 2
+    assert r.dir(0, 1, -1) == 2
+    assert r.dir(1, 1, 1) == 1
+    assert r.dir(-1, 1, -1) == 1
+    assert r.dir(0, 0, 0) == 0
+
+
+def test_uneven_radius():
+    # uneven per-direction radii are first-class (SURVEY §2.1)
+    r = Radius.constant(0)
+    r.set_dir(Dim3(1, 0, 0), 2)
+    r.set_dir(Dim3(-1, 0, 0), 1)
+    assert r.x(1) == 2
+    assert r.x(-1) == 1
+    assert r.y(1) == 0
+    assert r.lo() == Dim3(1, 0, 0)
+    assert r.hi() == Dim3(2, 0, 0)
+
+
+def test_equality():
+    assert Radius.constant(2) == Radius.constant(2)
+    assert Radius.constant(2) != Radius.constant(3)
+    assert Radius.face_edge_corner(2, 2, 2) != Radius.constant(2)  # center differs
+
+
+def test_validate_rejects_oversize_edge():
+    r = Radius.face_edge_corner(1, 2, 0)
+    with pytest.raises(ValueError):
+        r.validate()
+    Radius.face_edge_corner(3, 2, 1).validate()
+    Radius.constant(4).validate()

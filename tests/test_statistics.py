@@ -1,0 +1,50 @@
+"""Tier-1 units for Statistics (numeric parity with bin/statistics.cpp)."""
+
+import math
+
+from stencil_tpu.utils.statistics import Statistics
+
+
+def _filled(vals):
+    s = Statistics()
+    for v in vals:
+        s.insert(v)
+    return s
+
+
+def test_basic():
+    s = _filled([3.0, 1.0, 2.0])
+    assert s.count() == 3
+    assert s.min() == 1.0
+    assert s.max() == 3.0
+    assert s.avg() == 2.0
+    assert s.med() == 2.0
+
+
+def test_stddev_sample_denominator():
+    # statistics.cpp:48-55: n-1 denominator
+    s = _filled([1.0, 3.0])
+    assert s.stddev() == math.sqrt(2.0)
+
+
+def test_trimean_index_based():
+    # statistics.cpp:25-34: indices (n/4)*1, (n/4)*2, (n/4)*3 over sorted x
+    s = _filled([6.0, 1.0, 4.0, 2.0, 5.0, 3.0])  # sorted: 1..6, n=6, q=1
+    assert s.trimean() == (2.0 + 2 * 3.0 + 4.0) / 4
+    s8 = _filled([float(i) for i in range(8)])  # n=8, q=2 -> x[2],x[4],x[6]
+    assert s8.trimean() == (2.0 + 2 * 4.0 + 6.0) / 4
+
+
+def test_empty_is_nan():
+    s = Statistics()
+    assert math.isnan(s.min())
+    assert math.isnan(s.max())
+    assert math.isnan(s.trimean())
+    assert math.isnan(s.med())
+    assert math.isnan(s.avg())
+    assert math.isnan(s.stddev())
+
+
+def test_med_even_is_average():
+    # deliberate fix of the reference's even-n med bug (statistics.cpp:36-46)
+    assert _filled([1.0, 2.0, 3.0, 4.0]).med() == 2.5

@@ -1,0 +1,17 @@
+"""Decomposition, placement, and device-mesh construction (reference L5/L1)."""
+
+from stencil_tpu.parallel.partition import RankPartition, NodePartition, prime_factors
+from stencil_tpu.parallel.qap import qap_cost, qap_solve, qap_solve_catch
+from stencil_tpu.parallel.placement import Placement, TrivialPlacement, NodeAwarePlacement
+
+__all__ = [
+    "RankPartition",
+    "NodePartition",
+    "prime_factors",
+    "qap_cost",
+    "qap_solve",
+    "qap_solve_catch",
+    "Placement",
+    "TrivialPlacement",
+    "NodeAwarePlacement",
+]

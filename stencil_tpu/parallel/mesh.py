@@ -1,0 +1,53 @@
+"""Device-mesh construction for the 3D domain decomposition.
+
+The TPU-native replacement for the reference's rank/GPU assignment machinery
+(stencil.hpp:133-246 + partition.hpp placement): a ``jax.sharding.Mesh`` with
+axes ``('x', 'y', 'z')`` whose device grid comes from a ``Placement``.  All
+five reference transports ride this mesh as ``lax.ppermute`` (SURVEY.md §2.2
+TPU mapping).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.parallel.partition import NodePartition
+from stencil_tpu.parallel.placement import Placement, make_placement
+from stencil_tpu.parallel.topology import num_processes
+from stencil_tpu.utils.config import PlacementStrategy
+
+MESH_AXES = ("x", "y", "z")
+
+
+def choose_partition(size, radius: Radius, devices: Sequence) -> NodePartition:
+    """Two-level min-interface partition over the device fleet: DCN processes
+    play the reference's 'nodes', per-process devices its 'gpus'
+    (partition.hpp:647: NodeAware ctor builds NodePartition(nNodes, gpusPerNode))."""
+    n_proc = num_processes(devices)
+    per_proc = len(devices) // n_proc
+    return NodePartition(Dim3.of(size), radius, n_proc, per_proc)
+
+
+def make_mesh(
+    size,
+    radius: Radius,
+    devices: Optional[Sequence] = None,
+    strategy: PlacementStrategy = PlacementStrategy.NodeAware,
+):
+    """Partition ``size`` over ``devices`` and build the (Mesh, Placement)."""
+    if devices is None:
+        devices = jax.devices()
+    part = choose_partition(size, radius, devices)
+    placement = make_placement(strategy, part, devices, radius)
+    mesh = Mesh(placement.device_grid(), MESH_AXES)
+    return mesh, placement
+
+
+def mesh_from_grid(grid: np.ndarray) -> Mesh:
+    return Mesh(grid, MESH_AXES)

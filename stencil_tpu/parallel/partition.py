@@ -1,0 +1,199 @@
+"""3D domain decomposition by recursive prime-factor splitting.
+
+Parity targets: ``RankPartition`` (reference include/stencil/partition.hpp:23-146)
+and ``NodePartition`` (partition.hpp:148-310).
+
+* ``RankPartition(size, n)``: split ``size`` into ``n`` subdomains by the
+  prime factors of ``n``, largest factor first, always cutting the currently
+  longest axis (x wins ties, then y) — partition.hpp:56-78.
+* ``NodePartition(size, radius, nodes, gpus)``: same recursion but each step
+  cuts the plane with the smallest radius-weighted interface area
+  ``size.y*size.z*(r+x + r-x)`` etc. (partition.hpp:220-238), applied twice:
+  across nodes, then across GPUs within a node (partition.hpp:213-261).  On
+  TPU the two levels map to DCN-slice x ICI-mesh.
+* Uneven remainders: subdomain sizes are ``ceil`` sizes with trailing indices
+  shrunk by 1 (``subdomain_size`` partition.hpp:83-98, ``subdomain_origin``
+  partition.hpp:100-114).
+* ``linearize``/``dimensionize``: x fastest (partition.hpp:117-143).
+
+TPU note: XLA shards must be equal-sized, so ``DistributedDomain`` uses the
+*even* case directly and handles remainders by padding the global array up to
+``dim * ceil_size`` with a validity mask; this module still reproduces the
+reference's uneven sizes/origins exactly because they define the coordinate
+system of the unpadded user domain (and the parity tests).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.radius import Radius
+
+
+def prime_factors(n: int) -> List[int]:
+    """Prime factors of ``n``, largest first (partition.hpp:31-50: the
+    comparator sorts descending)."""
+    result: List[int] = []
+    if n == 0:
+        return result
+    while n % 2 == 0:
+        result.append(2)
+        n //= 2
+    i = 3
+    while i * i <= n:
+        while n % i == 0:
+            result.append(i)
+            n //= i
+        i += 2
+    if n > 2:
+        result.append(n)
+    return sorted(result, reverse=True)
+
+
+def _div_ceil(n: int, d: int) -> int:
+    return (n + d - 1) // d
+
+
+class _PartitionBase:
+    """Shared uneven-remainder and index math."""
+
+    _size: Dim3  # ceil subdomain size
+    _rem: Dim3  # input size % dim
+
+    def dim(self) -> Dim3:
+        raise NotImplementedError
+
+    def subdomain_size(self, idx) -> Dim3:
+        """partition.hpp:83-98: trailing indices shrink by one on axes with a
+        remainder."""
+        idx = Dim3.of(idx)
+        ret = [self._size.x, self._size.y, self._size.z]
+        for ax in range(3):
+            if self._rem[ax] != 0 and idx[ax] >= self._rem[ax]:
+                ret[ax] -= 1
+        return Dim3(*ret)
+
+    def subdomain_origin(self, idx) -> Dim3:
+        """partition.hpp:100-114."""
+        idx = Dim3.of(idx)
+        ret = [self._size.x * idx.x, self._size.y * idx.y, self._size.z * idx.z]
+        for ax in range(3):
+            if self._rem[ax] != 0 and idx[ax] >= self._rem[ax]:
+                ret[ax] -= idx[ax] - self._rem[ax]
+        return Dim3(*ret)
+
+    def linearize(self, idx) -> int:
+        """x fastest (partition.hpp:117-130)."""
+        idx = Dim3.of(idx)
+        d = self.dim()
+        assert idx.all_ge(0) and idx.x < d.x and idx.y < d.y and idx.z < d.z
+        return idx.x + idx.y * d.x + idx.z * d.y * d.x
+
+    def dimensionize(self, i: int) -> Dim3:
+        """partition.hpp:133-143."""
+        d = self.dim()
+        assert 0 <= i < d.flatten()
+        x = i % d.x
+        i //= d.x
+        y = i % d.y
+        z = i // d.y
+        return Dim3(x, y, z)
+
+    def is_even(self) -> bool:
+        return self._rem == Dim3(0, 0, 0)
+
+
+class RankPartition(_PartitionBase):
+    """Longest-axis recursive splitter (partition.hpp:56-78)."""
+
+    def __init__(self, size, n: int):
+        size = Dim3.of(size)
+        self._dim = Dim3(1, 1, 1)
+        cur = size
+        for amt in prime_factors(n):
+            if amt < 2:
+                continue
+            if cur.x >= cur.y and cur.x >= cur.z:
+                cur = cur.replace(0, _div_ceil(cur.x, amt))
+                self._dim = self._dim.replace(0, self._dim.x * amt)
+            elif cur.y >= cur.z:
+                cur = cur.replace(1, _div_ceil(cur.y, amt))
+                self._dim = self._dim.replace(1, self._dim.y * amt)
+            else:
+                cur = cur.replace(2, _div_ceil(cur.z, amt))
+                self._dim = self._dim.replace(2, self._dim.z * amt)
+        self._size = cur
+        self._rem = size % self._dim
+
+    def dim(self) -> Dim3:
+        return self._dim
+
+
+class NodePartition(_PartitionBase):
+    """Two-level min-interface splitter (partition.hpp:210-264).
+
+    ``sys_dim`` is the across-node (DCN) grid, ``node_dim`` the within-node
+    (ICI) grid; total grid is their product.
+    """
+
+    def __init__(self, size, radius: Radius, nodes: int, gpus: int):
+        size = Dim3.of(size)
+        self._sys_dim = Dim3(1, 1, 1)
+        self._node_dim = Dim3(1, 1, 1)
+        cur = size
+
+        def min_interface_axis(c: Dim3) -> int:
+            # partition.hpp:227-231: interface area scaled by the summed
+            # +/- face radii of the cut axis; x wins ties, then y
+            x_iface = c.y * c.z * (radius.dir(1, 0, 0) + radius.dir(-1, 0, 0))
+            y_iface = c.x * c.z * (radius.dir(0, 1, 0) + radius.dir(0, -1, 0))
+            z_iface = c.x * c.y * (radius.dir(0, 0, 1) + radius.dir(0, 0, -1))
+            if x_iface <= y_iface and x_iface <= z_iface:
+                return 0
+            if y_iface <= z_iface:
+                return 1
+            return 2
+
+        for level in range(2):
+            dim = Dim3(1, 1, 1)
+            for amt in prime_factors(nodes if level == 0 else gpus):
+                if amt < 2:
+                    continue
+                ax = min_interface_axis(cur)
+                cur = cur.replace(ax, _div_ceil(cur[ax], amt))
+                dim = dim.replace(ax, dim[ax] * amt)
+            if level == 0:
+                self._sys_dim = dim
+            else:
+                self._node_dim = dim
+
+        self._size = cur
+        self._rem = size % (self._sys_dim * self._node_dim)
+
+    def sys_dim(self) -> Dim3:
+        return self._sys_dim
+
+    def node_dim(self) -> Dim3:
+        return self._node_dim
+
+    def dim(self) -> Dim3:
+        return self._sys_dim * self._node_dim
+
+    def sys_idx(self, i: int) -> Dim3:
+        return _dimensionize_in(i, self._sys_dim)
+
+    def node_idx(self, i: int) -> Dim3:
+        return _dimensionize_in(i, self._node_dim)
+
+    def idx(self, i: int) -> Dim3:
+        return _dimensionize_in(i, self.dim())
+
+
+def _dimensionize_in(i: int, dim: Dim3) -> Dim3:
+    assert 0 <= i < dim.flatten()
+    x = i % dim.x
+    i //= dim.x
+    y = i % dim.y
+    z = i // dim.y
+    return Dim3(x, y, z)

@@ -1,0 +1,82 @@
+"""Device/torus topology introspection.
+
+Parity target: the discovery layer L1 — ``MpiTopology`` (reference
+include/stencil/mpi_topology.hpp:7) and ``gpu_topo::bandwidth`` (NVML distance
+matrix, src/gpu_topology.cpp:95-139).  On TPU the fabric is the ICI torus:
+``jax.Device.coords`` gives chip coordinates, and hop distance replaces the
+NVML common-ancestor tiers.  ``bandwidth = 1 / distance`` exactly as the
+reference (gpu_topology.cpp:95).
+
+For CPU (test) devices without coords, distance degrades to linear index
+distance — the moral equivalent of the reference degrading when NVML is
+absent.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: distance between a device and itself (gpu_topology.cpp:20-27 tier SAME=0.1,
+#: so self-bandwidth is large but finite)
+_SELF_DISTANCE = 0.1
+
+
+def device_coords(dev) -> Optional[Tuple[int, ...]]:
+    """TPU chips expose .coords (an (x,y,z) torus position); CPU devices don't."""
+    c = getattr(dev, "coords", None)
+    if c is None:
+        return None
+    return tuple(int(v) for v in c)
+
+
+def torus_dims(devices: Sequence) -> Optional[Tuple[int, ...]]:
+    coords = [device_coords(d) for d in devices]
+    if any(c is None for c in coords):
+        return None
+    arr = np.array(coords)
+    return tuple(int(v) for v in arr.max(axis=0) + 1)
+
+
+def distance_matrix(devices: Sequence) -> np.ndarray:
+    """Pairwise hop distance: torus Manhattan distance (with wrap) when chip
+    coords exist, else linear index distance.  Devices on different processes
+    (DCN) get an extra penalty, mirroring the reference's inter-node tier
+    being the most distant (gpu_topology.cpp:72-87)."""
+    n = len(devices)
+    dims = torus_dims(devices)
+    dist = np.zeros((n, n), dtype=float)
+    for i in range(n):
+        for j in range(n):
+            if i == j:
+                dist[i, j] = _SELF_DISTANCE
+                continue
+            if dims is not None:
+                ci = np.array(device_coords(devices[i]))
+                cj = np.array(device_coords(devices[j]))
+                d = np.abs(ci - cj)
+                d = np.minimum(d, np.array(dims) - d)  # torus wrap
+                hops = float(d.sum())
+            else:
+                hops = float(abs(i - j))
+            if devices[i].process_index != devices[j].process_index:
+                hops += 16.0  # DCN crossing dominates ICI hops
+            dist[i, j] = max(hops, _SELF_DISTANCE)
+    return dist
+
+
+def bandwidth_matrix(devices: Sequence) -> np.ndarray:
+    """gpu_topology.cpp:95: bandwidth = 1 / distance."""
+    return 1.0 / distance_matrix(devices)
+
+
+def num_processes(devices: Sequence) -> int:
+    return len({d.process_index for d in devices})
+
+
+def devices_by_process(devices: Sequence) -> List[List]:
+    by: dict = {}
+    for d in devices:
+        by.setdefault(d.process_index, []).append(d)
+    return [by[k] for k in sorted(by)]

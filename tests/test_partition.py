@@ -1,0 +1,93 @@
+"""Tier-1 units for partition math (mirrors test_cpu_partition.cpp exactly)."""
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.parallel.partition import NodePartition, RankPartition, prime_factors
+
+
+def test_prime_factors_descending():
+    # partition.hpp:31-50: sorted largest-first
+    assert prime_factors(12) == [3, 2, 2]
+    assert prime_factors(7) == [7]
+    assert prime_factors(1) == []
+    assert prime_factors(0) == []
+    assert prime_factors(60) == [5, 3, 2, 2]
+
+
+def test_10x5x5_into_2():
+    part = RankPartition(Dim3(10, 5, 5), 2)
+    assert part.dim() == Dim3(2, 1, 1)
+    assert part.subdomain_size(Dim3(0, 0, 0)) == Dim3(5, 5, 5)
+    assert part.subdomain_size(Dim3(1, 0, 0)) == Dim3(5, 5, 5)
+
+
+def test_10x3x1_into_4():
+    part = RankPartition(Dim3(10, 3, 1), 4)
+    assert part.subdomain_size(Dim3(0, 0, 0)) == Dim3(3, 3, 1)
+    assert part.subdomain_size(Dim3(1, 0, 0)) == Dim3(3, 3, 1)
+    assert part.subdomain_size(Dim3(2, 0, 0)) == Dim3(2, 3, 1)
+    assert part.subdomain_size(Dim3(3, 0, 0)) == Dim3(2, 3, 1)
+    assert part.subdomain_origin(Dim3(0, 0, 0)) == Dim3(0, 0, 0)
+    assert part.subdomain_origin(Dim3(1, 0, 0)) == Dim3(3, 0, 0)
+    assert part.subdomain_origin(Dim3(2, 0, 0)) == Dim3(6, 0, 0)
+    assert part.subdomain_origin(Dim3(3, 0, 0)) == Dim3(8, 0, 0)
+
+
+def test_10x5x5_into_3():
+    part = RankPartition(Dim3(10, 5, 5), 3)
+    assert part.subdomain_size(Dim3(0, 0, 0)) == Dim3(4, 5, 5)
+    assert part.subdomain_size(Dim3(1, 0, 0)) == Dim3(3, 5, 5)
+    assert part.subdomain_size(Dim3(2, 0, 0)) == Dim3(3, 5, 5)
+
+
+def test_13x7x7_into_4():
+    part = RankPartition(Dim3(13, 7, 7), 4)
+    assert part.subdomain_size(Dim3(0, 0, 0)) == Dim3(4, 7, 7)
+    assert part.subdomain_size(Dim3(1, 0, 0)) == Dim3(3, 7, 7)
+    assert part.subdomain_size(Dim3(2, 0, 0)) == Dim3(3, 7, 7)
+    assert part.subdomain_size(Dim3(3, 0, 0)) == Dim3(3, 7, 7)
+
+
+def test_10x14x2_into_9():
+    part = RankPartition(Dim3(10, 14, 2), 9)
+    assert part.subdomain_origin(Dim3(0, 0, 0)) == Dim3(0, 0, 0)
+    assert part.subdomain_origin(Dim3(1, 1, 0)) == Dim3(4, 5, 0)
+    assert part.subdomain_origin(Dim3(2, 2, 0)) == Dim3(7, 10, 0)
+
+
+def test_linearize_roundtrip():
+    part = RankPartition(Dim3(12, 12, 12), 8)
+    d = part.dim()
+    for i in range(d.flatten()):
+        assert part.linearize(part.dimensionize(i)) == i
+    # x fastest (partition.hpp:117-130)
+    assert part.linearize(Dim3(1, 0, 0)) == 1
+
+
+def test_node_partition_min_interface():
+    # min-interface: with a z-only radius, cutting z is most expensive; x/y free
+    r = Radius.constant(0)
+    r.set_dir(Dim3(0, 0, 1), 3)
+    r.set_dir(Dim3(0, 0, -1), 3)
+    part = NodePartition(Dim3(64, 64, 64), r, 1, 8)
+    assert part.dim().z == 1  # never cuts z
+    assert part.dim().flatten() == 8
+
+
+def test_node_partition_two_level():
+    part = NodePartition(Dim3(64, 64, 64), Radius.constant(1), 2, 4)
+    assert part.sys_dim().flatten() == 2
+    assert part.node_dim().flatten() == 4
+    assert part.dim() == part.sys_dim() * part.node_dim()
+    # uniform radius cube: splits spread over axes (cut axis = least interface)
+    assert sorted([part.dim().x, part.dim().y, part.dim().z]) == [1, 2, 4] or part.dim().flatten() == 8
+
+
+def test_node_partition_subdomain_cover():
+    """Subdomain sizes exactly tile the global volume (uneven case)."""
+    part = NodePartition(Dim3(10, 10, 10), Radius.constant(1), 1, 8)
+    total = 0
+    d = part.dim()
+    for i in range(d.flatten()):
+        total += part.subdomain_size(part.idx(i)).flatten()
+    assert total == 1000

@@ -1,0 +1,81 @@
+"""Tier-2: placement + mesh over the fake 8-device CPU fleet."""
+
+import jax
+import numpy as np
+import pytest
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.parallel.mesh import choose_partition, make_mesh
+from stencil_tpu.parallel.placement import NodeAwarePlacement, TrivialPlacement, comm_matrix
+from stencil_tpu.parallel.partition import NodePartition
+from stencil_tpu.parallel.topology import bandwidth_matrix, distance_matrix
+from stencil_tpu.utils.config import PlacementStrategy
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8  # conftest forces the fake fleet
+
+
+def test_comm_matrix_symmetric_counts():
+    part = NodePartition(Dim3(8, 8, 8), Radius.constant(1), 1, 8)
+    w = comm_matrix(part, Radius.constant(1))
+    n = part.dim().flatten()
+    assert w.shape == (n, n)
+    assert np.all(w.diagonal() == 0)
+    # periodic 2x2x2 partition: every pair of distinct subdomains is a neighbor
+    if part.dim() == Dim3(2, 2, 2):
+        assert np.all((w + np.eye(n)) > 0)
+
+
+def test_trivial_placement_roundtrip():
+    devices = jax.devices()
+    part = choose_partition(Dim3(16, 16, 16), Radius.constant(1), devices)
+    p = TrivialPlacement(part, devices)
+    for i in range(8):
+        idx = part.idx(i)
+        dev = p.get_device(idx)
+        assert p.get_idx(dev) == idx
+    grid = p.device_grid()
+    assert grid.shape == tuple(part.dim())
+    assert len({d.id for d in grid.flat}) == 8
+
+
+def test_node_aware_placement_valid_bijection():
+    devices = jax.devices()
+    part = choose_partition(Dim3(16, 16, 16), Radius.constant(1), devices)
+    p = NodeAwarePlacement(part, devices, Radius.constant(1))
+    assert sorted(p.assignment) == list(range(8))
+    assert np.isfinite(p.cost)
+    report = p.report()
+    assert "subdomain" in report and "device" in report
+
+
+def test_node_aware_no_worse_than_trivial():
+    devices = jax.devices()
+    part = choose_partition(Dim3(16, 16, 16), Radius.constant(1), devices)
+    radius = Radius.constant(1)
+    from stencil_tpu.parallel.qap import qap_cost
+
+    w = comm_matrix(part, radius)
+    dist = distance_matrix(devices)
+    na = NodeAwarePlacement(part, devices, radius)
+    trivial_cost = qap_cost(w, dist, list(range(8)))
+    assert na.cost <= trivial_cost + 1e-9
+
+
+def test_make_mesh():
+    mesh, placement = make_mesh(Dim3(16, 16, 16), Radius.constant(1), strategy=PlacementStrategy.NodeAware)
+    assert mesh.axis_names == ("x", "y", "z")
+    assert np.prod(mesh.devices.shape) == 8
+    assert tuple(placement.dim()) == mesh.devices.shape
+
+
+def test_distance_matrix_cpu_fallback():
+    devices = jax.devices()
+    d = distance_matrix(devices)
+    assert d.shape == (8, 8)
+    assert np.all(d.diagonal() == 0.1)
+    assert d[0, 1] == 1.0  # linear index distance on coord-less devices
+    bw = bandwidth_matrix(devices)
+    assert bw[0, 0] == 10.0

@@ -1,0 +1,87 @@
+"""Tier-1 units for the QAP solvers (mirrors test_cpu_qap.cpp)."""
+
+import numpy as np
+
+from stencil_tpu.parallel.qap import qap_cost, qap_solve, qap_solve_catch, solve_auto
+
+inf = float("inf")
+
+
+def reciprocal(bw):
+    # mat2d.hpp:176 make_reciprocal: distance = 1/bandwidth
+    return 1.0 / np.asarray(bw, dtype=float)
+
+
+def test_unbalanced_triangle():
+    # test_cpu_qap.cpp:12-27: high bw 0-2, high comm 0-1 -> map comm pair onto bw pair
+    bw = [[inf, 1, 10], [1, inf, 1], [10, 1, inf]]
+    comm = [[0, 10, 1], [10, 0, 1], [1, 1, 0]]
+    f, cost = qap_solve(comm, reciprocal(bw))
+    assert f == [0, 2, 1]
+
+
+def test_p9_exact():
+    # test_cpu_qap.cpp:29-57: P9-like 4-GPU node
+    bw = [[900, 75, 64, 64], [75, 900, 64, 64], [64, 64, 900, 75], [64, 64, 75, 900]]
+    comm = [[7, 5, 10, 1], [5, 7, 1, 10], [10, 1, 7, 5], [1, 10, 5, 7]]
+    f, cost = qap_solve(comm, reciprocal(bw))
+    assert f == [0, 2, 1, 3]
+
+
+def test_p9_catch():
+    # test_cpu_qap.cpp:59-86: 2-opt lands in a different (equal-cost) optimum
+    bw = [[900, 75, 64, 64], [75, 900, 64, 64], [64, 64, 900, 75], [64, 64, 75, 900]]
+    comm = [[7, 5, 10, 1], [5, 7, 1, 10], [10, 1, 7, 5], [1, 10, 5, 7]]
+    f, cost = qap_solve_catch(comm, reciprocal(bw))
+    assert f == [3, 1, 2, 0]
+
+
+def test_catch_cost_equals_true_cost():
+    """Incremental swap cost must equal full recomputation."""
+    rng = np.random.default_rng(0)
+    w = rng.random((8, 8))
+    d = rng.random((8, 8))
+    f, cost = qap_solve_catch(w, d)
+    assert np.isclose(cost, qap_cost(w, d, f))
+
+
+def test_catch_never_worse_than_identity():
+    rng = np.random.default_rng(1)
+    w = rng.random((16, 16))
+    d = rng.random((16, 16))
+    f, cost = qap_solve_catch(w, d)
+    assert cost <= qap_cost(w, d, list(range(16))) + 1e-12
+
+
+def test_exact_beats_or_ties_catch():
+    rng = np.random.default_rng(2)
+    w = rng.random((6, 6))
+    d = rng.random((6, 6))
+    _, exact_cost = qap_solve(w, d)
+    _, catch_cost = qap_solve_catch(w, d)
+    assert exact_cost <= catch_cost + 1e-12
+
+
+def test_zero_times_inf_guard():
+    # qap.hpp:15-20
+    w = [[0, 0], [0, 0]]
+    d = [[inf, inf], [inf, inf]]
+    assert qap_cost(w, d, [0, 1]) == 0
+
+
+def test_big_catch_runs():
+    # test_cpu_qap.cpp:88-108: 64x64 random just has to terminate
+    rng = np.random.default_rng(3)
+    w = rng.random((64, 64))
+    d = rng.random((64, 64))
+    f, cost = qap_solve_catch(w, d)
+    assert sorted(f) == list(range(64))
+
+
+def test_solve_auto_dispatch():
+    rng = np.random.default_rng(4)
+    w = rng.random((4, 4))
+    d = rng.random((4, 4))
+    f, cost = solve_auto(w, d)
+    fe, ce = qap_solve(w, d)
+    assert np.isclose(cost, ce)

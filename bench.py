@@ -1,0 +1,60 @@
+"""Headline benchmark: jacobi3d throughput on the available chip(s).
+
+Prints ONE JSON line:
+    {"metric": "jacobi3d_mcells_per_s_per_chip", "value": N, "unit": "Mcells/s", "vs_baseline": N}
+
+``vs_baseline`` normalizes against the reference's canonical GPU (Tesla
+V100-SXM2, the OLCF Summit chip its scripts target — scripts/summit/): a
+radius-1 7-point Jacobi iteration is HBM-bandwidth-bound at ~8 bytes/cell
+(one f32 read + one f32 write at perfect reuse), so V100's 900 GB/s gives a
+112,500 Mcells/s roofline.  vs_baseline = measured / 112500 — i.e. >=1 means
+one TPU chip beats the V100's theoretical best case, not merely a measured
+run.  (The reference repo publishes no measured numbers — BASELINE.md.)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+V100_ROOFLINE_MCELLS = 112_500.0
+
+
+def main() -> None:
+    import jax
+
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    dev = jax.devices()[0]
+    size = 512
+    model = Jacobi3D(size, size, size, devices=[dev])
+    model.realize()
+
+    # warmup + compile (device-side iteration: one dispatch runs many steps)
+    import jax.numpy as jnp
+
+    model.step(3)
+    float(jnp.sum(model.dd.get_curr(model.h)))  # force completion
+
+    iters = 50
+    t0 = time.perf_counter()
+    model.step(iters)
+    float(jnp.sum(model.dd.get_curr(model.h)))
+    dt = (time.perf_counter() - t0) / iters
+
+    cells = float(size) ** 3
+    mcells_per_s = cells / dt / 1e6
+    print(
+        json.dumps(
+            {
+                "metric": "jacobi3d_mcells_per_s_per_chip",
+                "value": round(mcells_per_s, 1),
+                "unit": "Mcells/s",
+                "vs_baseline": round(mcells_per_s / V100_ROOFLINE_MCELLS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Driver applications (reference ``bin/``): same CLIs, same CSV schemas."""

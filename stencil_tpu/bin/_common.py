@@ -1,0 +1,75 @@
+"""Shared driver plumbing: method flags, timing loops, CSV emission."""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+
+from stencil_tpu.utils.config import MethodFlags, PlacementStrategy
+
+
+def add_method_flags(p: argparse.ArgumentParser) -> None:
+    """The reference's transport-selection flags (jacobi3d.cu:111-120).  All
+    map onto the collective exchange on TPU; they are accepted (and echoed in
+    the CSV method string) so reference run scripts keep working."""
+    p.add_argument("--staged", action="store_true", help="Enable RemoteSender/Recver (ppermute on TPU)")
+    p.add_argument("--cuda-aware-mpi", action="store_true", help="Enable CudaAwareMpiSender/Recver (ppermute)")
+    p.add_argument("--colo", action="store_true", help="Enable ColocatedHaloSender/Recver (ppermute)")
+    p.add_argument("--peer", action="store_true", help="Enable PeerAccessSender (ppermute)")
+    p.add_argument("--kernel", action="store_true", help="Enable PeerCopySender (ppermute)")
+    p.add_argument("--trivial", action="store_true", help="Skip node-aware placement")
+
+
+def parse_methods(args) -> MethodFlags:
+    m = MethodFlags.Non
+    if args.staged:
+        m |= MethodFlags.CudaMpi
+    if getattr(args, "cuda_aware_mpi", False):
+        m |= MethodFlags.CudaAwareMpi
+    if args.colo:
+        m |= MethodFlags.CudaMpiColocated
+    if args.peer:
+        m |= MethodFlags.CudaMemcpyPeer
+    if args.kernel:
+        m |= MethodFlags.CudaKernel
+    if m == MethodFlags.Non:
+        m = MethodFlags.All
+    return m
+
+
+def method_str(args) -> str:
+    """jacobi3d.cu:355-374 method string."""
+    parts = []
+    if args.staged:
+        parts.append("staged")
+    if getattr(args, "cuda_aware_mpi", False):
+        parts.append("cuda-aware")
+    if args.colo:
+        parts.append("colo")
+    if args.peer:
+        parts.append("peer")
+    if args.kernel:
+        parts.append("kernel")
+    if not parts:
+        parts.append("ppermute")  # TPU default method
+    return "/".join(parts)
+
+
+def parse_strategy(args) -> PlacementStrategy:
+    return PlacementStrategy.Trivial if args.trivial else PlacementStrategy.NodeAware
+
+
+def ranks_and_devcount():
+    """(MPI size, per-process device count) analogs."""
+    return jax.process_count(), jax.local_device_count()
+
+
+class WallTimer:
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        self.elapsed = time.perf_counter() - self.t0

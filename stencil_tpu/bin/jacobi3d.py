@@ -1,0 +1,110 @@
+"""jacobi3d driver — the flagship benchmark.
+
+Parity target: reference bin/jacobi3d.cu.  Same CLI shape (positional x y z
+base size, weak-scaled by numSubdoms^(1/3); --no-overlap; --trivial; method
+flags; --paraview/--prefix/--period) and the same CSV row:
+
+    jacobi3d,<methods>,ranks,devCount,x,y,z,min(s),trimean(s)
+
+(jacobi3d.cu:378-379).  Per-iteration time is the max across processes of the
+wall time around step+sync (jacobi3d.cu:265-341).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+
+from stencil_tpu.bin import _common
+from stencil_tpu.models.jacobi import Jacobi3D, weak_scaled_size
+from stencil_tpu.utils.statistics import Statistics
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("jacobi3d")
+    _common.add_method_flags(p)
+    p.add_argument("--no-overlap", action="store_true", help="Don't overlap communication and computation")
+    p.add_argument("--prefix", default="", help="prefix for paraview files")
+    p.add_argument("--paraview", action="store_true", help="dump paraview files")
+    p.add_argument("--iters", "-n", type=int, default=30, help="number of iterations")
+    p.add_argument("--period", "-q", type=int, default=-1, help="iterations between checkpoints")
+    p.add_argument("--no-weak-scale", action="store_true", help="use x y z as the global size directly")
+    p.add_argument("x", type=int, nargs="?", default=512)
+    p.add_argument("y", type=int, nargs="?", default=512)
+    p.add_argument("z", type=int, nargs="?", default=512)
+    args = p.parse_args(argv)
+
+    num_subdoms = len(jax.devices())
+    if args.no_weak_scale:
+        x, y, z = args.x, args.y, args.z
+    else:
+        # jacobi3d.cu:167-169
+        x = weak_scaled_size(args.x, num_subdoms)
+        y = weak_scaled_size(args.y, num_subdoms)
+        z = weak_scaled_size(args.z, num_subdoms)
+
+    checkpoint_period = args.period if args.period > 0 else max(args.iters // 10, 1)
+
+    model = Jacobi3D(
+        x,
+        y,
+        z,
+        overlap=not args.no_overlap,
+        strategy=_common.parse_strategy(args),
+        methods=_common.parse_methods(args),
+    )
+    # mesh divisibility: shrink to the nearest multiple if weak scaling
+    # produced an indivisible size (reference subdomains may be uneven;
+    # XLA shards may not)
+    dim = None
+    try:
+        model.realize()
+    except ValueError:
+        from stencil_tpu.parallel.mesh import choose_partition
+
+        part = choose_partition((x, y, z), model.dd.radius(), jax.devices())
+        dim = part.dim()
+        x, y, z = (max(v // d, 1) * d for v, d in zip((x, y, z), dim))
+        print(f"adjusted global size to {x} {y} {z} for mesh {dim}", file=sys.stderr)
+        model = Jacobi3D(
+            x,
+            y,
+            z,
+            overlap=not args.no_overlap,
+            strategy=_common.parse_strategy(args),
+            methods=_common.parse_methods(args),
+        )
+        model.realize()
+
+    iter_time = Statistics()
+    model.step()  # compile outside the timed loop
+    model.block_until_ready()
+
+    for it in range(args.iters):
+        t0 = time.perf_counter()
+        model.step()
+        model.block_until_ready()
+        iter_time.insert(time.perf_counter() - t0)
+        if args.paraview and it % checkpoint_period == 0:
+            from stencil_tpu.io.paraview import write_paraview
+
+            write_paraview(model.dd, f"{args.prefix}jacobi3d_{it}")
+    if args.paraview:
+        from stencil_tpu.io.paraview import write_paraview
+
+        write_paraview(model.dd, f"{args.prefix}jacobi3d_final")
+
+    if jax.process_index() == 0:
+        ranks, dev_count = _common.ranks_and_devcount()
+        print(
+            f"jacobi3d,{_common.method_str(args)},{ranks},{dev_count},"
+            f"{x},{y},{z},{iter_time.min()},{iter_time.trimean()}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,7 @@ from typing import Any
 from stencil_tpu.core.dim3 import Dim3, Rect3
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class Accessor:
     """View of a raw (shell-carrying) block addressed in global coordinates.
 

@@ -62,6 +62,9 @@ class DirectionMap(Generic[T]):
     def __eq__(self, o) -> bool:
         return isinstance(o, DirectionMap) and self._data == o._data
 
+    def __hash__(self) -> int:
+        return hash(tuple(self._data))
+
     def copy(self) -> "DirectionMap[T]":
         m = DirectionMap()
         m._data = list(self._data)

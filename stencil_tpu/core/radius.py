@@ -130,6 +130,11 @@ class Radius:
     def __eq__(self, o) -> bool:
         return isinstance(o, Radius) and self._rads == o._rads
 
+    def __hash__(self) -> int:
+        # hash of current contents; like any mutable-keyed dict use, mutating
+        # after insertion is on the caller (needed so frozen LocalSpec hashes)
+        return hash(self._rads)
+
     def __repr__(self) -> str:
         vals = {tuple(d): self.dir(d) for d in DIRECTIONS_26 if self.dir(d)}
         return f"Radius({vals})"

@@ -1,0 +1,422 @@
+"""``DistributedDomain`` — the public orchestrator.
+
+Parity target: reference ``DistributedDomain`` (include/stencil/stencil.hpp:61
++ src/stencil.cu).  Same lifecycle: construct with a global size, configure
+(``set_radius`` / ``add_data`` / ``set_methods`` / ``set_placement``), then
+``realize()`` and iterate ``exchange()`` / compute / ``swap()``.
+
+TPU design (not a translation):
+
+* A quantity is ONE global ``jax.Array`` sharded ``P('x','y','z')`` over the
+  3D device mesh.  Each shard is the reference's ``LocalDomain`` allocation —
+  interior plus halo shell (``raw_size``) — so the global array has shape
+  ``dim * raw_size`` and the *logical* user domain is the union of shard
+  interiors.  Double buffering is two array slots whose references swap
+  (reference src/local_domain.cu:41-54); buffer donation makes the step
+  in-place in HBM.
+* ``exchange()`` is a jitted 3-axis-sweep ppermute (ops/exchange.py) — the
+  whole transport layer of the reference.
+* ``make_step`` builds the fused exchange+compute step with
+  interior/exterior overlap (reference src/stencil.cu:567-666 +
+  jacobi3d.cu:265-337): interior compute carries no data dependency on the
+  ppermutes, so XLA overlaps communication with compute — the job of the
+  reference's entire sender/recver state-machine zoo.
+
+v0 constraint: the global size must divide evenly by the mesh (XLA shards are
+equal); the reference's ±1-cell remainders (partition.hpp:83-114) are handled
+by requiring divisible sizes (pad-and-mask is the planned extension, SURVEY.md
+§7 "Hard parts").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from stencil_tpu.core.dim3 import Dim3, Rect3
+from stencil_tpu.core.geometry import LocalSpec
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.ops.exchange import halo_exchange_shard, make_exchange_fn
+from stencil_tpu.parallel.mesh import MESH_AXES, make_mesh
+from stencil_tpu.parallel.placement import Placement
+from stencil_tpu.utils.config import MethodFlags, PlacementStrategy
+from stencil_tpu.utils.logging import log_debug, log_info
+
+
+@dataclasses.dataclass(frozen=True)
+class DataHandle:
+    """Typed handle to a named quantity (reference local_domain.cuh:17-25)."""
+
+    name: str
+    dtype: object
+
+
+class ShardView:
+    """Per-shard stencil-term access used inside step kernels.
+
+    ``sh(dx,dy,dz)`` returns the region's cells shifted by the offset —
+    the reference's ``src[o + Dim3(dx,dy,dz)]`` Accessor pattern
+    (accessor.hpp:27-40) as a fused slice.
+    """
+
+    def __init__(self, block: jax.Array, r_lo: Dim3, region: Tuple[slice, slice, slice]):
+        self._block = block
+        self._lo = r_lo
+        self._region = region
+
+    def sh(self, dx: int = 0, dy: int = 0, dz: int = 0) -> jax.Array:
+        idx = []
+        for ax, d in zip(range(3), (dx, dy, dz)):
+            s = self._region[ax]
+            idx.append(slice(self._lo[ax] + s.start + d, self._lo[ax] + s.stop + d))
+        return self._block[tuple(idx)]
+
+    def center(self) -> jax.Array:
+        return self.sh(0, 0, 0)
+
+
+@dataclasses.dataclass
+class BlockInfo:
+    """Traced per-shard context handed to step kernels."""
+
+    origin: Tuple[jax.Array, jax.Array, jax.Array]  # global coords of interior start
+    interior: Dim3  # interior size per shard
+    global_size: Dim3
+    radius: Radius
+    region: Tuple[slice, slice, slice]  # interior-local region being computed
+
+    def coords(self):
+        """Global (x, y, z) coordinate arrays for the region, broadcastable
+        to the region's shape."""
+        s = self.region
+        cx = self.origin[0] + jnp.arange(s[0].start, s[0].stop)
+        cy = self.origin[1] + jnp.arange(s[1].start, s[1].stop)
+        cz = self.origin[2] + jnp.arange(s[2].start, s[2].stop)
+        return cx[:, None, None], cy[None, :, None], cz[None, None, :]
+
+
+#: a step kernel: (views, info) -> {name: new values for info.region}
+StepKernel = Callable[[Dict[str, ShardView], BlockInfo], Dict[str, jax.Array]]
+
+
+class DistributedDomain:
+    def __init__(self, x: int, y: int, z: int):
+        self._size = Dim3(x, y, z)
+        self._radius = Radius.constant(0)
+        self._handles: List[DataHandle] = []
+        self._methods = MethodFlags.All
+        self._strategy = PlacementStrategy.NodeAware
+        self._devices: Optional[Sequence] = None
+        self._realized = False
+        # post-realize state
+        self.mesh: Optional[Mesh] = None
+        self.placement: Optional[Placement] = None
+        self._spec: Optional[LocalSpec] = None
+        self._curr: Dict[str, jax.Array] = {}
+        self._next: Dict[str, jax.Array] = {}
+        self._exchange_fn = None
+        self._exchange_count = 0
+
+    # --- configuration (stencil.hpp:276-306) ---------------------------------
+    def set_radius(self, radius) -> None:
+        self._radius = Radius.constant(radius) if isinstance(radius, int) else radius
+
+    def radius(self) -> Radius:
+        return self._radius
+
+    def add_data(self, name: str, dtype=jnp.float32) -> DataHandle:
+        h = DataHandle(name, jnp.dtype(dtype))
+        self._handles.append(h)
+        return h
+
+    def set_methods(self, methods: MethodFlags) -> None:
+        self._methods = methods
+
+    def set_placement(self, strategy: PlacementStrategy) -> None:
+        self._strategy = strategy
+
+    def set_devices(self, devices: Sequence) -> None:
+        """Analog of set_gpus (stencil.hpp:306): restrict/order the devices."""
+        self._devices = devices
+
+    def size(self) -> Dim3:
+        return self._size
+
+    # --- realize (src/stencil.cu:27-539) -------------------------------------
+    def realize(self) -> None:
+        self._radius.validate()
+        devices = list(self._devices) if self._devices is not None else jax.devices()
+        self.mesh, self.placement = make_mesh(self._size, self._radius, devices, self._strategy)
+        dim = self.placement.dim()
+        for ax in range(3):
+            if self._size[ax] % dim[ax] != 0:
+                raise ValueError(
+                    f"global size {self._size} not divisible by mesh {dim} on axis "
+                    f"{ax}; pad the domain (uneven shards are a planned extension)"
+                )
+        n = self._size // dim
+        r = self._radius
+        if min(n.x, n.y, n.z) < max(r.lo().x, r.lo().y, r.lo().z, r.hi().x, r.hi().y, r.hi().z):
+            raise ValueError(f"subdomain {n} smaller than radius shell")
+        # all shards share one spec (even split); per-shard origin varies
+        self._spec = LocalSpec.make(n, Dim3(0, 0, 0), r)
+        raw = self._spec.raw_size()
+        sharding = NamedSharding(self.mesh, P(*MESH_AXES))
+        gshape = (dim.x * raw.x, dim.y * raw.y, dim.z * raw.z)
+        for h in self._handles:
+            self._curr[h.name] = jnp.zeros(gshape, dtype=h.dtype, device=sharding)
+            self._next[h.name] = jnp.zeros(gshape, dtype=h.dtype, device=sharding)
+        self._exchange_fn = make_exchange_fn(self.mesh, r)
+        self._realized = True
+        log_info(f"realized {self._size} over mesh {dim} (raw shard {raw})")
+
+    # --- geometry accessors ---------------------------------------------------
+    def local_spec(self) -> LocalSpec:
+        return self._spec
+
+    def subdomain_size(self) -> Dim3:
+        return self._spec.sz
+
+    def get_interior(self) -> Rect3:
+        """Interior region in interior-local coords (src/stencil.cu:567-610)."""
+        return self._spec.interior()
+
+    def get_exterior(self) -> List[Rect3]:
+        return self._spec.exterior()
+
+    def num_subdomains(self) -> int:
+        return self.placement.dim().flatten()
+
+    # --- data movement --------------------------------------------------------
+    def _to_raw_global(self, interior: np.ndarray, dtype) -> np.ndarray:
+        """Scatter a (X,Y,Z) user-domain array into the shell-carrying global
+        layout (host-side; used for init and small domains)."""
+        dim = self.placement.dim()
+        n = self._spec.sz
+        raw = self._spec.raw_size()
+        lo = self._radius.lo()
+        out = np.zeros((dim.x * raw.x, dim.y * raw.y, dim.z * raw.z), dtype=dtype)
+        for ix in range(dim.x):
+            for iy in range(dim.y):
+                for iz in range(dim.z):
+                    src = interior[
+                        ix * n.x : (ix + 1) * n.x,
+                        iy * n.y : (iy + 1) * n.y,
+                        iz * n.z : (iz + 1) * n.z,
+                    ]
+                    out[
+                        ix * raw.x + lo.x : ix * raw.x + lo.x + n.x,
+                        iy * raw.y + lo.y : iy * raw.y + lo.y + n.y,
+                        iz * raw.z + lo.z : iz * raw.z + lo.z + n.z,
+                    ] = src
+        return out
+
+    def _from_raw_global(self, raw_arr: np.ndarray) -> np.ndarray:
+        dim = self.placement.dim()
+        n = self._spec.sz
+        raw = self._spec.raw_size()
+        lo = self._radius.lo()
+        out = np.zeros((self._size.x, self._size.y, self._size.z), dtype=raw_arr.dtype)
+        for ix in range(dim.x):
+            for iy in range(dim.y):
+                for iz in range(dim.z):
+                    out[
+                        ix * n.x : (ix + 1) * n.x,
+                        iy * n.y : (iy + 1) * n.y,
+                        iz * n.z : (iz + 1) * n.z,
+                    ] = raw_arr[
+                        ix * raw.x + lo.x : ix * raw.x + lo.x + n.x,
+                        iy * raw.y + lo.y : iy * raw.y + lo.y + n.y,
+                        iz * raw.z + lo.z : iz * raw.z + lo.z + n.z,
+                    ]
+        return out
+
+    def set_quantity(self, h: DataHandle, interior: np.ndarray, slot: str = "curr") -> None:
+        """Load a full (X,Y,Z) user-domain array into a quantity's interior."""
+        assert interior.shape == tuple(self._size), (interior.shape, self._size)
+        raw = self._to_raw_global(np.asarray(interior), h.dtype)
+        sharding = NamedSharding(self.mesh, P(*MESH_AXES))
+        arr = jax.device_put(jnp.asarray(raw), sharding)
+        (self._curr if slot == "curr" else self._next)[h.name] = arr
+
+    def quantity_to_host(self, h: DataHandle, slot: str = "curr") -> np.ndarray:
+        """Gather a quantity's interior to a (X,Y,Z) host array (analog of
+        reference quantity_to_host, local_domain.cuh:329-346)."""
+        arr = (self._curr if slot == "curr" else self._next)[h.name]
+        return self._from_raw_global(np.asarray(jax.device_get(arr)))
+
+    def raw_to_host(self, h: DataHandle, slot: str = "curr") -> np.ndarray:
+        """The raw shell-carrying global array (halos visible) for tests."""
+        arr = (self._curr if slot == "curr" else self._next)[h.name]
+        return np.asarray(jax.device_get(arr))
+
+    def init_by_coords(self, h: DataHandle, fn, include_halo: bool = False) -> None:
+        """Device-side init: ``fn(cx, cy, cz)`` maps broadcastable global
+        coordinate arrays to values.  Fills the interior (and optionally the
+        shell, for analytic whole-domain fields)."""
+        n = self._spec.sz
+        raw = self._spec.raw_size()
+        lo = self._radius.lo()
+        mesh_shape = tuple(self.mesh.shape[a] for a in MESH_AXES)
+
+        def per_shard(block):
+            ox = lax.axis_index(MESH_AXES[0]) * n.x
+            oy = lax.axis_index(MESH_AXES[1]) * n.y
+            oz = lax.axis_index(MESH_AXES[2]) * n.z
+            if include_halo:
+                cx = ox - lo.x + jnp.arange(raw.x)
+                cy = oy - lo.y + jnp.arange(raw.y)
+                cz = oz - lo.z + jnp.arange(raw.z)
+                vals = fn(cx[:, None, None], cy[None, :, None], cz[None, None, :])
+                return jnp.broadcast_to(vals, tuple(raw)).astype(block.dtype)
+            cx = ox + jnp.arange(n.x)
+            cy = oy + jnp.arange(n.y)
+            cz = oz + jnp.arange(n.z)
+            vals = fn(cx[:, None, None], cy[None, :, None], cz[None, None, :])
+            vals = jnp.broadcast_to(vals, tuple(n)).astype(block.dtype)
+            return block.at[lo.x : lo.x + n.x, lo.y : lo.y + n.y, lo.z : lo.z + n.z].set(vals)
+
+        spec = P(*MESH_AXES)
+        out = jax.jit(
+            jax.shard_map(per_shard, mesh=self.mesh, in_specs=(spec,), out_specs=spec)
+        )(self._curr[h.name])
+        self._curr[h.name] = out
+
+    # --- the hot path ---------------------------------------------------------
+    def exchange(self) -> None:
+        """Fill every quantity's halo shell (src/stencil.cu:670-864)."""
+        assert self._realized
+        self._curr = self._exchange_fn(self._curr)
+        self._exchange_count += 1
+
+    def swap(self) -> None:
+        """Swap curr/next slots (src/stencil.cu:541-561)."""
+        self._curr, self._next = self._next, self._curr
+
+    def get_curr(self, h: DataHandle) -> jax.Array:
+        return self._curr[h.name]
+
+    def get_next(self, h: DataHandle) -> jax.Array:
+        return self._next[h.name]
+
+    def exchange_bytes_total(self) -> int:
+        """Analytic bytes-per-exchange across all subdomains
+        (src/stencil.cu:6-25 exchange_bytes_for_method analog)."""
+        from stencil_tpu.core.geometry import exchange_bytes
+
+        per_dom = exchange_bytes(self._spec, [h.dtype.itemsize for h in self._handles])
+        return per_dom * self.num_subdomains()
+
+    # --- fused step builder ---------------------------------------------------
+    def make_step(self, kernel: StepKernel, overlap: bool = True, donate: bool = True):
+        """Build ``step(curr) -> next`` fusing exchange + compute.
+
+        ``overlap=True`` splits interior/exterior (reference overlap pipeline,
+        jacobi3d.cu:265-337): the interior update reads no halo cells and so
+        carries no dependency on the ppermutes — XLA schedules them
+        concurrently.  ``overlap=False`` computes the whole region after the
+        exchange (jacobi3d.cu:312-329 --no-overlap).
+        """
+        assert self._realized
+        n = self._spec.sz
+        r = self._radius
+        lo = r.lo()
+        mesh_shape = tuple(self.mesh.shape[a] for a in MESH_AXES)
+        names = [h.name for h in self._handles]
+
+        interior_rect = self._spec.interior()
+        exterior_rects = self._spec.exterior()
+
+        def rect_to_slices(rect: Rect3):
+            return tuple(slice(rect.lo[ax], rect.hi[ax]) for ax in range(3))
+
+        full_region = rect_to_slices(self._spec.compute_region())
+
+        def region_update(blocks, region, origin):
+            views = {k: ShardView(b, lo, region) for k, b in blocks.items()}
+            info = BlockInfo(origin, n, self._size, r, region)
+            return kernel(views, info)
+
+        def write_region(new_block, region, vals):
+            idx = tuple(
+                slice(lo[ax] + region[ax].start, lo[ax] + region[ax].stop) for ax in range(3)
+            )
+            return new_block.at[idx].set(vals)
+
+        def one_step(blocks):
+            origin = tuple(
+                lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)
+            )
+            new_blocks = dict(blocks)
+            if overlap:
+                # 1) interior: no halo reads -> no ppermute dependency
+                int_region = rect_to_slices(interior_rect)
+                int_vals = region_update(blocks, int_region, origin)
+                # 2) exchange
+                exch = {k: halo_exchange_shard(b, r, mesh_shape) for k, b in blocks.items()}
+                # 3) exterior slabs read the fresh halos
+                ext_vals = [
+                    (rect_to_slices(rect), region_update(exch, rect_to_slices(rect), origin))
+                    for rect in exterior_rects
+                ]
+                for k in names:
+                    nb = new_blocks[k]
+                    if k in int_vals:
+                        nb = write_region(nb, int_region, int_vals[k])
+                    for region, vals in ext_vals:
+                        if k in vals:
+                            nb = write_region(nb, region, vals[k])
+                    new_blocks[k] = nb
+            else:
+                exch = {k: halo_exchange_shard(b, r, mesh_shape) for k, b in blocks.items()}
+                vals = region_update(exch, full_region, origin)
+                for k in names:
+                    if k in vals:
+                        new_blocks[k] = write_region(new_blocks[k], full_region, vals[k])
+            return new_blocks
+
+        def per_shard(steps, *blocks_tuple):
+            blocks = dict(zip(names, blocks_tuple))
+            # device-side iteration: many steps per dispatch.  The fused,
+            # replayed step graph is the TPU analog of the reference's
+            # CUDA-Graph pack replay (packer.cuh:168-187) — and in-loop
+            # dynamic-update-slices stay in place in HBM.
+            blocks = lax.fori_loop(0, steps, lambda _, b: one_step(b), blocks)
+            return tuple(blocks[k] for k in names)
+
+        spec = P(*MESH_AXES)
+        donate_kw = {"donate_argnums": 0} if donate else {}
+
+        @partial(jax.jit, static_argnums=1, **donate_kw)
+        def step(curr: Dict[str, jax.Array], steps: int = 1) -> Dict[str, jax.Array]:
+            fn = jax.shard_map(
+                partial(per_shard, steps),
+                mesh=self.mesh,
+                in_specs=tuple(spec for _ in names),
+                out_specs=tuple(spec for _ in names),
+            )
+            outs = fn(*[curr[k] for k in names])
+            return dict(zip(names, outs))
+
+        return step
+
+    def run_step(self, step_fn, steps: int = 1) -> None:
+        """Apply a built step to curr and make its output the new curr.
+
+        The built step already fuses the buffer rotation: with donation the
+        old curr's HBM is reused for the output (the functional analog of the
+        reference's pointer swap, src/local_domain.cu:41-54), so the old
+        arrays must not be retained — the ``next`` slot is left untouched.
+
+        ``steps > 1`` runs that many iterations in ONE device dispatch
+        (``lax.fori_loop`` inside the shard_map) — essential on TPU, where
+        per-dispatch overhead would otherwise dominate small steps.
+        """
+        self._curr = step_fn(self._curr, steps)

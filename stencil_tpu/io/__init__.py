@@ -1,0 +1,7 @@
+"""Persistence: paraview point dumps (reference parity) and checkpoint/resume
+(a deliberate improvement over the reference, which has none — SURVEY.md §5)."""
+
+from stencil_tpu.io.paraview import write_paraview
+from stencil_tpu.io.checkpoint import save_checkpoint, restore_checkpoint
+
+__all__ = ["write_paraview", "save_checkpoint", "restore_checkpoint"]

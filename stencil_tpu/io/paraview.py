@@ -1,0 +1,39 @@
+"""Paraview CSV point dumps.
+
+Parity target: ``DistributedDomain::write_paraview`` (reference
+src/stencil.cu:866-939): one ``<prefix>_<id>.txt`` per subdomain with header
+``Z,Y,X,<q0>,<q1>...`` and one row per interior point, z-major, coordinates in
+global space, ``%f``-formatted values, NaNs optionally zeroed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from stencil_tpu.core.dim3 import Dim3
+
+
+def write_paraview(dd, prefix: str, zero_nans: bool = True) -> None:
+    """One file per subdomain, matching the reference's id and row layout."""
+    dim = dd.placement.dim()
+    n = dd.local_spec().sz
+    names = [h.name or f"data{i}" for i, h in enumerate(dd._handles)]
+    fields = {h.name: dd.quantity_to_host(h) for h in dd._handles}
+
+    for i in range(dim.flatten()):
+        idx = dd.placement.partition.idx(i)
+        origin = Dim3(idx.x * n.x, idx.y * n.y, idx.z * n.z)
+        path = f"{prefix}_{i}.txt"
+        with open(path, "w") as f:
+            f.write("Z,Y,X" + "".join(f",{c}" for c in names) + "\n")
+            for lz in range(n.z):
+                for ly in range(n.y):
+                    for lx in range(n.x):
+                        pos = origin + Dim3(lx, ly, lz)
+                        row = f"{pos.z},{pos.y},{pos.x}"
+                        for h in dd._handles:
+                            val = float(fields[h.name][pos.x, pos.y, pos.z])
+                            if zero_nans and np.isnan(val):
+                                val = 0.0
+                            row += f",{val:f}"
+                        f.write(row + "\n")

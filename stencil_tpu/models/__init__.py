@@ -1,0 +1,12 @@
+"""Model families: the reference's driver applications as reusable models.
+
+* ``jacobi`` — 7-point Jacobi heat stencil with hot/cold sphere forcing
+  (reference bin/jacobi3d.cu), the flagship app.
+* ``astaroth`` — radius-3 multi-quantity MHD proxy (reference
+  bin/astaroth_sim.cu).
+"""
+
+from stencil_tpu.models.jacobi import Jacobi3D
+from stencil_tpu.models.astaroth import AstarothSim
+
+__all__ = ["Jacobi3D", "AstarothSim"]

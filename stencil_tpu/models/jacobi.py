@@ -1,0 +1,104 @@
+"""7-point Jacobi heat stencil with hot/cold sphere forcing.
+
+Parity target: reference bin/jacobi3d.cu — the flagship app.  Semantics
+replicated exactly:
+
+* single float quantity, radius-1 faces-only stencil (jacobi3d.cu:205-214,227)
+* init: whole domain at (HOT+COLD)/2 (jacobi3d.cu:15-29)
+* forcing (jacobi3d.cu:40-66): a hot sphere (radius = X/10) centered at
+  (X/3, Y/2, Z/2) is clamped to HOT each step; a cold sphere at (2X/3, Y/2,
+  Z/2) clamped to COLD; elsewhere next = mean of the 6 face neighbors.
+  ``dist`` is the reference's float-sqrt truncated to integer
+  (jacobi3d.cu:31-33).
+* iteration: overlapped interior/exchange/exterior pipeline or single
+  whole-region kernel under --no-overlap (jacobi3d.cu:265-337).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.utils.config import MethodFlags, PlacementStrategy
+
+COLD_TEMP = 0.0
+HOT_TEMP = 1.0
+
+
+class Jacobi3D:
+    def __init__(
+        self,
+        x: int,
+        y: int,
+        z: int,
+        overlap: bool = True,
+        strategy: PlacementStrategy = PlacementStrategy.NodeAware,
+        methods: MethodFlags = MethodFlags.All,
+        devices=None,
+        dtype=jnp.float32,
+    ):
+        self.dd = DistributedDomain(x, y, z)
+        # radius 1 on faces only (jacobi3d.cu:205-214)
+        radius = Radius.constant(0)
+        radius.set_face(1)
+        self.dd.set_radius(radius)
+        self.dd.set_methods(methods)
+        self.dd.set_placement(strategy)
+        if devices is not None:
+            self.dd.set_devices(devices)
+        self.h = self.dd.add_data("temp", dtype=dtype)
+        self.overlap = overlap
+        self._step = None
+
+    def realize(self) -> None:
+        self.dd.realize()
+        # set compute region to (HOT+COLD)/2 (jacobi3d.cu:15-29, 253-263)
+        mid = (HOT_TEMP + COLD_TEMP) / 2
+        self.dd.init_by_coords(self.h, lambda x, y, z: jnp.full((), mid) + 0 * (x + y + z))
+        self._step = self.dd.make_step(self._kernel, overlap=self.overlap)
+
+    def _kernel(self, views, info):
+        size = info.global_size
+        hot_c = Dim3(size.x // 3, size.y // 2, size.z // 2)
+        cold_c = Dim3(size.x * 2 // 3, size.y // 2, size.z // 2)
+        sphere_r = size.x // 10
+
+        src = views["temp"]
+        val = (
+            src.sh(1, 0, 0)
+            + src.sh(-1, 0, 0)
+            + src.sh(0, 1, 0)
+            + src.sh(0, -1, 0)
+            + src.sh(0, 0, 1)
+            + src.sh(0, 0, -1)
+        ) / 6.0
+
+        cx, cy, cz = info.coords()
+
+        def dist2(c: Dim3):
+            return (cx - c.x) ** 2 + (cy - c.y) ** 2 + (cz - c.z) ** 2
+
+        # truncated-float-sqrt distance (jacobi3d.cu:31-33)
+        def trunc_dist(c: Dim3):
+            return jnp.floor(jnp.sqrt(dist2(c).astype(jnp.float32)))
+
+        val = jnp.where(trunc_dist(hot_c) <= sphere_r, HOT_TEMP, val)
+        val = jnp.where(trunc_dist(cold_c) <= sphere_r, COLD_TEMP, val)
+        return {"temp": val.astype(src.center().dtype)}
+
+    def step(self, steps: int = 1) -> None:
+        self.dd.run_step(self._step, steps)
+
+    def temperature(self) -> np.ndarray:
+        return self.dd.quantity_to_host(self.h)
+
+    def block_until_ready(self) -> None:
+        self.dd.get_curr(self.h).block_until_ready()
+
+
+def weak_scaled_size(base: int, num_subdomains: int) -> int:
+    """jacobi3d.cu:167-169: scale each axis by numSubdoms^(1/3), rounded."""
+    return int(float(base) * float(num_subdomains) ** 0.33333 + 0.5)

@@ -1,0 +1,5 @@
+"""Device-side ops: halo exchange collectives, pack/unpack, stencil helpers."""
+
+from stencil_tpu.ops.exchange import halo_exchange_shard, make_exchange_fn
+
+__all__ = ["halo_exchange_shard", "make_exchange_fn"]

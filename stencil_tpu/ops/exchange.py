@@ -1,0 +1,134 @@
+"""The halo exchange — the reference's entire transport layer as collectives.
+
+Replaces the five transports + poll loop (reference tx_cuda.cuh:39-974,
+src/stencil.cu:670-864) with ``lax.ppermute`` inside ``shard_map`` over the 3D
+device mesh.  ICI plays NVLink/IPC; DCN plays inter-node MPI; XLA's async
+collective scheduling replaces the hand-rolled state machines (SURVEY.md §2.2
+"TPU mapping").
+
+Design: each shard is a *shell-carrying* block — interior of size ``n`` plus
+``radius`` face-widths of halo on each side, exactly the reference's
+``LocalDomain`` allocation (local_domain.cuh:309-313 ``raw_size``).  The
+exchange runs **three axis sweeps** (x, then y, then z).  Each sweep sends
+slabs spanning the *full* extent of the other axes — including their already-
+filled halos — so edge and corner data propagate without dedicated diagonal
+messages: 26 neighbor messages collapse into <=6 ppermutes (SURVEY.md §7
+"26-neighbor exchange").
+
+The ``-dir`` extent convention holds by construction: the slab sent in
+direction ``+a`` has width ``radius(-a)`` (the receiver's ``-a`` halo width),
+and the slab sent in ``-a`` has width ``radius(+a)`` (packer.cuh:91-93).
+
+A mesh axis of size 1 still ppermutes to itself — that self-wrap implements
+periodic boundaries within one shard, the collapse of the reference's
+same-GPU ``PeerAccessSender`` kernels (tx_cuda.cuh:39-104).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.parallel.mesh import MESH_AXES
+
+
+def _shift_from_low(x, axis_name: str, n: int):
+    """Each shard receives the value held by its -1 neighbor (data moves +)."""
+    return lax.ppermute(x, axis_name, [(k, (k + 1) % n) for k in range(n)])
+
+
+def _shift_from_high(x, axis_name: str, n: int):
+    """Each shard receives the value held by its +1 neighbor (data moves -)."""
+    return lax.ppermute(x, axis_name, [(k, (k - 1) % n) for k in range(n)])
+
+
+def halo_exchange_shard(
+    block: jax.Array,
+    radius: Radius,
+    mesh_shape: Tuple[int, int, int],
+    axis_names: Sequence[str] = MESH_AXES,
+) -> jax.Array:
+    """Fill the halo shell of one shell-carrying shard.  Must run inside
+    ``shard_map`` over a mesh with ``axis_names``.
+
+    ``block`` has extent ``interior + r_lo + r_hi`` per axis; the interior
+    occupies ``[r_lo, r_lo + n)``.
+    """
+    for axis in range(3):
+        r_lo = radius.axis(axis, -1)  # my low-side halo width
+        r_hi = radius.axis(axis, +1)  # my high-side halo width
+        if r_lo == 0 and r_hi == 0:
+            continue
+        name = axis_names[axis]
+        n_dev = mesh_shape[axis]
+        size = block.shape[axis]  # raw extent on this axis
+        interior_hi = size - r_hi  # one past last interior element
+
+        def axslice(lo, hi):
+            idx = [slice(None)] * block.ndim
+            idx[axis] = slice(lo, hi)
+            return tuple(idx)
+
+        updates = []
+        if r_lo > 0:
+            # my low halo [0, r_lo) <- -axis neighbor's interior top slab,
+            # width r_lo (the message traveling +axis has extent radius(-axis))
+            slab = block[axslice(interior_hi - r_lo, interior_hi)]
+            recv = _shift_from_low(slab, name, n_dev)
+            updates.append((axslice(0, r_lo), recv))
+        if r_hi > 0:
+            # my high halo [interior_hi, size) <- +axis neighbor's interior
+            # bottom slab, width r_hi
+            slab = block[axslice(r_lo, r_lo + r_hi)]
+            recv = _shift_from_high(slab, name, n_dev)
+            updates.append((axslice(interior_hi, size), recv))
+        for idx, val in updates:
+            block = block.at[idx].set(val)
+    return block
+
+
+def make_exchange_fn(mesh: Mesh, radius: Radius, ndim_extra: int = 0):
+    """Build a jitted exchange over a pytree of shell-carrying global arrays.
+
+    Returns ``exchange(arrays) -> arrays`` where each array is sharded
+    ``P('x','y','z')`` on its last three dims (``ndim_extra`` leading batch/
+    quantity dims are unsharded).  Donates its input: the halo write is
+    in-place in HBM, like the reference filling halos inside the existing
+    allocation.
+    """
+    mesh_shape = tuple(mesh.shape[a] for a in MESH_AXES)
+    spec = P(*([None] * ndim_extra), *MESH_AXES)
+
+    @partial(jax.jit, donate_argnums=0)
+    def exchange(arrays):
+        def per_shard(*blocks):
+            out = []
+            for b in blocks:
+                # leading batch dims ride along: halo axes are the last three
+                if ndim_extra:
+                    bb = b.reshape((-1,) + b.shape[-3:])
+                    bb = jax.vmap(
+                        lambda v: halo_exchange_shard(v, radius, mesh_shape)
+                    )(bb)
+                    out.append(bb.reshape(b.shape))
+                else:
+                    out.append(halo_exchange_shard(b, radius, mesh_shape))
+            return tuple(out)
+
+        leaves, treedef = jax.tree.flatten(arrays)
+        shard_fn = jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=tuple(spec for _ in leaves),
+            out_specs=tuple(spec for _ in leaves),
+        )
+        return jax.tree.unflatten(treedef, list(shard_fn(*leaves)))
+
+    return exchange

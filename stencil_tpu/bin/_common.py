@@ -66,6 +66,24 @@ def ranks_and_devcount():
     return jax.process_count(), jax.local_device_count()
 
 
+def fit_to_mesh(x: int, y: int, z: int, radius, devices=None):
+    """Round each axis to the NEAREST multiple of the mesh dim (reference
+    subdomains may be uneven, partition.hpp:83-114; XLA shards may not — the
+    nearest divisible size keeps weak-scaled runs comparable).  The per-axis
+    shard is clamped up to the radius shell so realize() cannot reject it."""
+    from stencil_tpu.parallel.mesh import choose_partition
+
+    if devices is None:
+        devices = jax.devices()
+    part = choose_partition((x, y, z), radius, devices)
+    dim = part.dim()
+    lo, hi = radius.lo(), radius.hi()
+    min_shard = max(lo.x, lo.y, lo.z, hi.x, hi.y, hi.z, 1)
+    return tuple(
+        max(round(v / d), min_shard) * d for v, d in zip((x, y, z), dim)
+    )
+
+
 class WallTimer:
     def __enter__(self):
         self.t0 = time.perf_counter()

@@ -1,0 +1,78 @@
+"""astaroth-sim driver — Astaroth MHD proxy benchmark.
+
+Parity target: reference bin/astaroth_sim.cu: radius-3 26-direction halos,
+sin-wave init, 6-point averaging stencil, interior/exchange/exterior overlap
+loop, 5 fixed iterations (astaroth_sim.cu:184,223-274).  The reference prints
+progress to stderr only; we additionally emit one jacobi3d-style CSV row so
+runs are comparable:
+
+    astaroth,<methods>,ranks,devCount,x,y,z,min(s),trimean(s)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+
+from stencil_tpu.bin import _common
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.models.astaroth import AstarothSim
+from stencil_tpu.utils.statistics import Statistics
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("astaroth-sim")
+    # cxxopts options (astaroth_sim.cu:89-110): x/y/z size, transport flags
+    p.add_argument("--x", type=int, default=512)
+    p.add_argument("--y", type=int, default=512)
+    p.add_argument("--z", type=int, default=512)
+    p.add_argument("--iters", type=int, default=5)  # astaroth_sim.cu:223 fixed 5
+    p.add_argument("--quantities", type=int, default=1, help="exchanged fields (real Astaroth: 8)")
+    p.add_argument("--remote", dest="staged", action="store_true")
+    p.add_argument("--cuda-aware-mpi", dest="cuda_aware_mpi", action="store_true")
+    p.add_argument("--colocated", dest="colo", action="store_true")
+    p.add_argument("--peer-copy", dest="peer", action="store_true")
+    p.add_argument("--kernel", action="store_true")
+    p.add_argument("--no-overlap", action="store_true")
+    p.add_argument("--trivial", action="store_true")
+    args = p.parse_args(argv)
+
+    num_subdoms = len(jax.devices())
+    print(f"assuming {num_subdoms} subdomains", file=sys.stderr)
+    x, y, z = _common.fit_to_mesh(args.x, args.y, args.z, Radius.constant(3))
+    print(f"domain: {x},{y},{z}", file=sys.stderr)
+
+    sim = AstarothSim(
+        x,
+        y,
+        z,
+        num_quantities=args.quantities,
+        overlap=not args.no_overlap,
+        strategy=_common.parse_strategy(args),
+    )
+    sim.realize()
+    sim.step()  # compile
+    sim.block_until_ready()
+
+    iter_time = Statistics()
+    for it in range(args.iters):
+        t0 = time.perf_counter()
+        sim.step()
+        sim.block_until_ready()
+        iter_time.insert(time.perf_counter() - t0)
+        print(f"iter {it}: {iter_time.max():e}s", file=sys.stderr)
+
+    if jax.process_index() == 0:
+        ranks, dev_count = _common.ranks_and_devcount()
+        print(
+            f"astaroth,{_common.method_str(args)},{ranks},{dev_count},"
+            f"{x},{y},{z},{iter_time.min()},{iter_time.trimean()}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

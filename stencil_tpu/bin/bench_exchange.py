@@ -1,0 +1,112 @@
+"""bench-exchange — microbenchmark sweep of radius shapes.
+
+Parity target: reference bin/bench_exchange.cu: on a fixed per-device extent
+(default 128^3, bench_exchange.cu:79), run exchange+swap under a sweep of
+radius configurations — +x-only, ±x, faces-only, faces+edges(eR), uniform —
+and report the reference's exact CSV (bench_exchange.cu:57-64):
+
+    name,count,trimean (S),trimean (B/s),stddev,min,avg,max
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.bin import _common
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.utils.statistics import Statistics
+
+
+def bench(n_iters: int, n_quants: int, ext, radius: Radius):
+    """One config: returns (Statistics of per-iter seconds, exchanged bytes)."""
+    x, y, z = _common.fit_to_mesh(ext[0], ext[1], ext[2], radius)
+    dd = DistributedDomain(x, y, z)
+    dd.set_radius(radius)
+    for i in range(n_quants):
+        dd.add_data(f"d{i}", dtype=jnp.float32)
+    dd.realize()
+    stats = Statistics()
+    dd.exchange()  # compile
+    dd.swap()
+    for a in dd._curr.values():
+        a.block_until_ready()
+    for _ in range(n_iters):
+        t0 = time.perf_counter()
+        dd.exchange()
+        dd.swap()
+        for a in dd._curr.values():
+            a.block_until_ready()
+        stats.insert(time.perf_counter() - t0)
+    return stats, dd.exchange_bytes_total()
+
+
+def report_header() -> str:
+    return "name,count,trimean (S),trimean (B/s),stddev,min,avg,max"
+
+
+def report(cfg: str, bytes_: int, stats: Statistics) -> str:
+    tm = stats.trimean()
+    bps = bytes_ / tm if tm else float("nan")
+    return (
+        f"{cfg},{stats.count()},{tm:e},{bps:e},"
+        f"{stats.stddev():e},{stats.min():e},{stats.avg():e},{stats.max():e}"
+    )
+
+
+def sweep_configs(ext, fR: int, eR: int):
+    """The five radius shapes of bench_exchange.cu:121-195."""
+    tag = f"{ext[0]}-{ext[1]}-{ext[2]}"
+
+    r = Radius.constant(0)
+    r.set_dir(Dim3(1, 0, 0), fR)
+    yield f"{tag}/px/{fR}", r
+
+    r = Radius.constant(0)
+    r.set_dir(Dim3(1, 0, 0), fR)
+    r.set_dir(Dim3(-1, 0, 0), fR)
+    yield f"{tag}/x/{fR}", r
+
+    r = Radius.constant(0)
+    r.set_face(fR)
+    yield f"{tag}/faces/{fR}", r
+
+    r = Radius.constant(fR)
+    for sx in (1, -1):
+        for sy in (1, -1):
+            for sz in (1, -1):
+                r.set_dir(Dim3(sx, sy, sz), eR)
+    yield f"{tag}/face&edge/{fR}/{eR}", r
+
+    yield f"{tag}/uniform/2", Radius.constant(2)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("bench-exchange")
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--quantities", type=int, default=1)
+    p.add_argument("--x", type=int, default=128)
+    p.add_argument("--y", type=int, default=128)
+    p.add_argument("--z", type=int, default=128)
+    p.add_argument("--face-radius", type=int, default=2, dest="fR")
+    p.add_argument("--edge-radius", type=int, default=1, dest="eR")
+    args = p.parse_args(argv)
+
+    ext = (args.x, args.y, args.z)
+    if jax.process_index() == 0:
+        print(report_header())
+    for name, radius in sweep_configs(ext, args.fR, args.eR):
+        stats, bytes_ = bench(args.iters, args.quantities, ext, radius)
+        if jax.process_index() == 0:
+            print(report(name, bytes_, stats))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+"""bench-qap — CRAFT (2-opt) vs exact QAP solver benchmark.
+
+Parity target: reference bin/bench_qap.cu: for s = 2..39, generate
+blkdiag / random / matched weight+distance matrices (bench_qap.cu:16-111) and
+report per-solve seconds and solution cost for the 2-opt heuristic, plus the
+exact solver for s < 9 (bench_qap.cu:112-160).  Output format matches:
+
+    <name>
+    size CRAFT(s) cost exact(s) cost
+    2 <t> <c> <t> <c>
+    ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+from stencil_tpu.parallel.qap import qap_solve, qap_solve_catch
+
+
+def make_random(s: int, rng) -> tuple:
+    return rng.random((s, s)) * 1e4, rng.random((s, s)) * 1e4
+
+
+def make_matched(s: int, rng) -> tuple:
+    w = rng.random((s, s)) * 1e4 + 1e-9
+    return w, 1.0 / w
+
+
+def blkdiag(s, d_min, d_max, od_min, od_max, blk_min, blk_max, rng) -> np.ndarray:
+    """Block-diagonal high-weight blocks over a low-weight background
+    (bench_qap.cu:50-96)."""
+    m = np.zeros((s, s))
+    r = 0
+    while r < s:
+        blk = min(int(rng.integers(blk_min, blk_max + 1)), s - r)
+        m[r : r + blk, r : r + blk] = rng.uniform(d_min, d_max, (blk, blk))
+        m[r : r + blk, r + blk :] = rng.uniform(od_min, od_max, (blk, s - r - blk))
+        m[r + blk :, r : r + blk] = rng.uniform(od_min, od_max, (s - r - blk, blk))
+        r += blk
+    return m
+
+
+def make_blkdiag(s: int, rng) -> tuple:
+    # 2..26-sized blocks of high comm weight; 6x6 blocks of high bandwidth
+    # (bench_qap.cu:98-110: a P9 NVLink-island-like distance structure)
+    w = blkdiag(s, 100, 200, 10, 20, 2, 26, rng)
+    d = blkdiag(s, 1 / 100.0, 1 / 64.0, 1 / 26.0, 1 / 25.0, 6, 6, rng)
+    return w, d
+
+
+def bench(name: str, func, n_iters: int, max_s: int, exact_below: int) -> None:
+    print(name)
+    print("size CRAFT(s) cost exact(s) cost")
+    rng = np.random.default_rng(0)
+    for s in range(2, max_s):
+        w, d = func(s, rng)
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            _, cost = qap_solve_catch(w, d)
+        craft_t = (time.perf_counter() - t0) / n_iters
+        line = f"{s} {craft_t:g} {cost:g}"
+        if s < exact_below:
+            t0 = time.perf_counter()
+            for _ in range(n_iters):
+                _, cost = qap_solve(w, d)
+            exact_t = (time.perf_counter() - t0) / n_iters
+            line += f" {exact_t:g} {cost:g}"
+        else:
+            line += " - -"
+        print(line)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("bench-qap")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--max-size", type=int, default=40)
+    p.add_argument("--exact-below", type=int, default=9)
+    args = p.parse_args(argv)
+    bench("blkdiag", make_blkdiag, args.iters, args.max_size, args.exact_below)
+    bench("random", make_random, args.iters, args.max_size, args.exact_below)
+    bench("matched", make_matched, args.iters, args.max_size, args.exact_below)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,17 @@ def main(argv=None) -> int:
 
     checkpoint_period = args.period if args.period > 0 else max(args.iters // 10, 1)
 
+    # mesh divisibility: fit to the nearest multiple if weak scaling produced
+    # an indivisible size (reference subdomains may be uneven; XLA shards may
+    # not)
+    from stencil_tpu.core.radius import Radius
+
+    r = Radius.constant(0)
+    r.set_face(1)
+    fx, fy, fz = _common.fit_to_mesh(x, y, z, r)
+    if (fx, fy, fz) != (x, y, z):
+        print(f"adjusted global size {x} {y} {z} -> {fx} {fy} {fz}", file=sys.stderr)
+        x, y, z = fx, fy, fz
     model = Jacobi3D(
         x,
         y,
@@ -56,28 +67,7 @@ def main(argv=None) -> int:
         strategy=_common.parse_strategy(args),
         methods=_common.parse_methods(args),
     )
-    # mesh divisibility: shrink to the nearest multiple if weak scaling
-    # produced an indivisible size (reference subdomains may be uneven;
-    # XLA shards may not)
-    dim = None
-    try:
-        model.realize()
-    except ValueError:
-        from stencil_tpu.parallel.mesh import choose_partition
-
-        part = choose_partition((x, y, z), model.dd.radius(), jax.devices())
-        dim = part.dim()
-        x, y, z = (max(v // d, 1) * d for v, d in zip((x, y, z), dim))
-        print(f"adjusted global size to {x} {y} {z} for mesh {dim}", file=sys.stderr)
-        model = Jacobi3D(
-            x,
-            y,
-            z,
-            overlap=not args.no_overlap,
-            strategy=_common.parse_strategy(args),
-            methods=_common.parse_methods(args),
-        )
-        model.realize()
+    model.realize()
 
     iter_time = Statistics()
     model.step()  # compile outside the timed loop

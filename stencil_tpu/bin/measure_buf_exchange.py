@@ -1,0 +1,101 @@
+"""measure-buf-exchange — feedback controller equalizing per-pair copy times.
+
+Parity target: reference bin/measure_buf_exchange.cu: find per-pair message
+sizes that make every device<->device transfer take the same target time
+(4 ms), by gradient descent on the sizes over 50 iterations
+(measure_buf_exchange.cu:32,189-223).  The TPU equivalent adjusts per-pair
+``lax.ppermute`` payload sizes.  Per iteration it prints the size matrix ``x``
+(MiB), measured times ``y``, and the adjustment ``dx``
+(measure_buf_exchange.cu:91-96,180-185,209-214), then the final sizes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MiB = 1024 * 1024
+
+
+def measure_edge(mesh, n_dev: int, src: int, dst: int, nbytes: int, n_iters: int) -> float:
+    sharding = NamedSharding(mesh, P("d"))
+    n_elems = max(int(nbytes) // 4, 1)
+
+    @jax.jit
+    def go(x):
+        def f(blk):
+            return lax.ppermute(blk, "d", [(src, dst)])
+
+        return jax.shard_map(f, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x)
+
+    x = jax.device_put(jnp.ones((n_elems * n_dev,), jnp.float32), sharding)
+    go(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        y = go(x)
+    y.block_until_ready()
+    return (time.perf_counter() - t0) / n_iters
+
+
+def print_mat(label: str, m: np.ndarray, fmt) -> None:
+    print(label)
+    for i in range(m.shape[0]):
+        print(" ".join(fmt(m[i, j]) for j in range(m.shape[1])))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("measure-buf-exchange")
+    p.add_argument("--target", type=float, default=4e-3, help="target seconds per pair")
+    p.add_argument("--iters", type=int, default=50, help="controller iterations")
+    p.add_argument("--sub-iters", type=int, default=3, help="timing reps per measurement")
+    p.add_argument("--init-mib", type=float, default=1.0, help="initial size (MiB)")
+    p.add_argument("--tol", type=float, default=0.05, help="relative convergence tolerance")
+    args = p.parse_args(argv)
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("d",))
+
+    x = np.zeros((n, n))  # per-pair sizes in bytes
+    for i in range(n):
+        for j in range(n):
+            if i != j or n == 1:
+                x[i, j] = args.init_mib * MiB
+
+    for it in range(args.iters):
+        y = np.zeros((n, n))
+        for i in range(n):
+            for j in range(n):
+                if x[i, j] == 0:
+                    continue
+                y[i, j] = measure_edge(mesh, n, i, j, int(x[i, j]), args.sub_iters)
+        # multiplicative update toward the target time (the reference's
+        # per-pair gradient step, measure_buf_exchange.cu:189-223)
+        active = x > 0
+        ratio = np.ones_like(x)
+        ratio[active] = args.target / y[active]
+        ratio = ratio.clip(0.5, 2.0)  # damp
+        dx = (x * ratio - x).astype(np.int64)
+        print_mat("x", x / MiB, lambda v: f"{v:.2f}")
+        print_mat("y", y, lambda v: f"{v:.4e}")
+        print_mat("dx", dx, lambda v: f"{int(v)}")
+        converged = np.all(np.abs(y[active] - args.target) <= args.tol * args.target)
+        if converged:
+            break
+        x = (x + dx).clip(4096, None) * active
+
+    print("final x (MiB)")
+    for i in range(n):
+        print(" ".join(f"{x[i, j] / MiB:.2f}" for j in range(n)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

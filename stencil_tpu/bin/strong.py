@@ -1,0 +1,31 @@
+"""strong — exchange-only strong-scaling benchmark.
+
+Parity target: reference bin/strong.cu: identical to weak.cu but the global
+size is NOT scaled by the device count (strong.cu:30-48; defaults 512^3).
+Same CSV row layout (the reference even prints "weak," for the strong binary,
+strong.cu:181 — we emit "strong," so rows are distinguishable).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+
+from stencil_tpu.bin import _common
+from stencil_tpu.bin.weak import build_parser, run
+from stencil_tpu.core.radius import Radius
+
+
+def main(argv=None) -> int:
+    args = build_parser("strong").parse_args(argv)
+    args.trivial = args.naive
+    x, y, z = _common.fit_to_mesh(args.x, args.y, args.z, Radius.constant(3))
+    row = run(x, y, z, args.n_iters, args, name="strong")
+    if jax.process_index() == 0:
+        print(row)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""weak — exchange-only weak-scaling benchmark.
+
+Parity target: reference bin/weak.cu.  Same shape: positional ``x y z nIters``
+base size weak-scaled by ``numGpus^(1/3)`` (weak.cu:63-65), radius 3, four
+float quantities (weak.cu:120,132-135), nIters of exchange+swap, then one CSV
+row of bytes-per-method + all setup/exchange timers (weak.cu:173-194):
+
+    weak,<methods>,x,y,z,s,MPI(B),Colocated(B),cudaMemcpyPeer(B),direct(B),
+    iters,gpus,nodes,ranks,mpi_topo,node_gpus,peer_en,placement,realize,plan,
+    create,exchange,swap
+
+On TPU all exchange bytes ride the collective path, so they are reported in
+the MPI(B) column (the reference's "All"-method column layout is preserved for
+script compatibility); peer_en/node_gpus phases don't exist and report 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.bin import _common
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.models.jacobi import weak_scaled_size
+from stencil_tpu.utils.config import MethodFlags
+
+
+def run(x: int, y: int, z: int, n_iters: int, args, name: str = "weak") -> str:
+    dd = DistributedDomain(x, y, z)
+    dd.set_methods(_common.parse_methods(args))
+    dd.set_radius(Radius.constant(3))  # weak.cu:120
+    dd.set_placement(_common.parse_strategy(args))
+    for i in range(4):  # weak.cu:132-135
+        dd.add_data(f"d{i}", dtype=jnp.float32)
+    dd.enable_exchange_stats(True)
+    dd.realize()
+
+    for _ in range(n_iters):
+        dd.exchange()
+        dd.swap()
+
+    ranks, dev_count = _common.ranks_and_devcount()
+    num_gpus = ranks * dev_count
+    num_nodes = ranks
+    s = dd.stats
+    row = (
+        f"{name},{_common.method_str(args)},{x},{y},{z},{x * y * z},"
+        f"{dd.exchange_bytes_for_method(MethodFlags.CudaMpi)},"
+        f"{dd.exchange_bytes_for_method(MethodFlags.AllGather)},"
+        f"{dd.exchange_bytes_for_method(MethodFlags.AllGather)},"
+        f"{dd.exchange_bytes_for_method(MethodFlags.AllGather)},"
+        f"{n_iters},{num_gpus},{num_nodes},{ranks},"
+        f"{s.time_topo:e},{0.0:e},{0.0:e},{s.time_placement:e},"
+        f"{s.time_realize:e},{s.time_plan:e},{s.time_create:e},"
+        f"{s.time_exchange:e},{s.time_swap:e}"
+    )
+    return row
+
+
+def build_parser(name: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(name)
+    p.add_argument("x", type=int, nargs="?", default=512)
+    p.add_argument("y", type=int, nargs="?", default=512)
+    p.add_argument("z", type=int, nargs="?", default=512)
+    p.add_argument("n_iters", type=int, nargs="?", default=30)
+    p.add_argument("--kernel", action="store_true")
+    p.add_argument("--peer", action="store_true")
+    p.add_argument("--colo", action="store_true")
+    p.add_argument("--naive", action="store_true", help="trivial placement (weak.cu --naive)")
+    p.add_argument("--cuda-aware", dest="cuda_aware_mpi", action="store_true")
+    p.add_argument("--staged", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser("weak").parse_args(argv)
+    args.trivial = args.naive
+    devs = len(jax.devices())
+    # weak.cu:63-65 round-to-nearest scaling
+    x = weak_scaled_size(args.x, devs)
+    y = weak_scaled_size(args.y, devs)
+    z = weak_scaled_size(args.z, devs)
+    x, y, z = _common.fit_to_mesh(x, y, z, Radius.constant(3))
+    print(
+        f"{devs} subdomains: {x},{y},{z}={x * y * z}",
+        file=sys.stderr,
+    )
+    row = run(x, y, z, args.n_iters, args, name="weak")
+    if jax.process_index() == 0:
+        print(row)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

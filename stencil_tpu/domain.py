@@ -31,6 +31,8 @@ by requiring divisible sizes (pad-and-mask is the planned extension, SURVEY.md
 from __future__ import annotations
 
 import dataclasses
+import os
+import time
 from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -56,6 +58,24 @@ class DataHandle:
 
     name: str
     dtype: object
+
+
+@dataclasses.dataclass
+class DomainStats:
+    """Setup/exchange wall-time accounting (reference STENCIL_SETUP_STATS /
+    STENCIL_EXCHANGE_STATS, stencil.hpp:106-131).  Setup phases map:
+    mpi_topo -> process/device discovery, placement -> partition+QAP solve,
+    realize -> array allocation, plan -> exchange-fn construction,
+    create -> jit trace+compile of the exchange (the analog of sender/recver
+    creation + CUDA-Graph capture, src/stencil.cu:385-529)."""
+
+    time_topo: float = 0.0
+    time_placement: float = 0.0
+    time_realize: float = 0.0
+    time_plan: float = 0.0
+    time_create: float = 0.0
+    time_exchange: float = 0.0
+    time_swap: float = 0.0
 
 
 class ShardView:
@@ -123,6 +143,11 @@ class DistributedDomain:
         self._next: Dict[str, jax.Array] = {}
         self._exchange_fn = None
         self._exchange_count = 0
+        self.stats = DomainStats()
+        # blocking per-exchange timing costs a device sync per call, exactly
+        # like the reference's barrier-per-call EXCHANGE_STATS (default OFF,
+        # CMakeLists.txt:20); opt in via env or enable_exchange_stats().
+        self._exchange_stats = os.environ.get("STENCIL_EXCHANGE_STATS", "0") == "1"
 
     # --- configuration (stencil.hpp:276-306) ---------------------------------
     def set_radius(self, radius) -> None:
@@ -150,10 +175,17 @@ class DistributedDomain:
         return self._size
 
     # --- realize (src/stencil.cu:27-539) -------------------------------------
+    def enable_exchange_stats(self, on: bool = True) -> None:
+        self._exchange_stats = on
+
     def realize(self) -> None:
         self._radius.validate()
+        t0 = time.perf_counter()
         devices = list(self._devices) if self._devices is not None else jax.devices()
+        self.stats.time_topo = time.perf_counter() - t0
+        t0 = time.perf_counter()
         self.mesh, self.placement = make_mesh(self._size, self._radius, devices, self._strategy)
+        self.stats.time_placement = time.perf_counter() - t0
         dim = self.placement.dim()
         for ax in range(3):
             if self._size[ax] % dim[ax] != 0:
@@ -170,10 +202,21 @@ class DistributedDomain:
         raw = self._spec.raw_size()
         sharding = NamedSharding(self.mesh, P(*MESH_AXES))
         gshape = (dim.x * raw.x, dim.y * raw.y, dim.z * raw.z)
+        t0 = time.perf_counter()
         for h in self._handles:
             self._curr[h.name] = jnp.zeros(gshape, dtype=h.dtype, device=sharding)
             self._next[h.name] = jnp.zeros(gshape, dtype=h.dtype, device=sharding)
+        self.stats.time_realize = time.perf_counter() - t0
+        t0 = time.perf_counter()
         self._exchange_fn = make_exchange_fn(self.mesh, r)
+        self.stats.time_plan = time.perf_counter() - t0
+        # eager trace+compile of the exchange — the analog of the reference's
+        # sender/recver creation + CUDA-Graph capture (src/stencil.cu:385-529);
+        # later exchange() calls hit the executable cache.
+        if self._handles:
+            t0 = time.perf_counter()
+            self._exchange_fn.lower(self._curr).compile()
+            self.stats.time_create = time.perf_counter() - t0
         self._realized = True
         log_info(f"realized {self._size} over mesh {dim} (raw shard {raw})")
 
@@ -293,12 +336,20 @@ class DistributedDomain:
     def exchange(self) -> None:
         """Fill every quantity's halo shell (src/stencil.cu:670-864)."""
         assert self._realized
+        t0 = time.perf_counter() if self._exchange_stats else 0.0
         self._curr = self._exchange_fn(self._curr)
+        if self._exchange_stats:
+            for a in self._curr.values():
+                a.block_until_ready()
+            self.stats.time_exchange += time.perf_counter() - t0
         self._exchange_count += 1
 
     def swap(self) -> None:
         """Swap curr/next slots (src/stencil.cu:541-561)."""
+        t0 = time.perf_counter() if self._exchange_stats else 0.0
         self._curr, self._next = self._next, self._curr
+        if self._exchange_stats:
+            self.stats.time_swap += time.perf_counter() - t0
 
     def get_curr(self, h: DataHandle) -> jax.Array:
         return self._curr[h.name]
@@ -313,6 +364,14 @@ class DistributedDomain:
 
         per_dom = exchange_bytes(self._spec, [h.dtype.itemsize for h in self._handles])
         return per_dom * self.num_subdomains()
+
+    def exchange_bytes_for_method(self, method: MethodFlags) -> int:
+        """Per-method byte counter (src/stencil.cu:6-25).  On TPU every
+        transport is the collective path, so all bytes are attributed to
+        ``Ppermute`` (= reference All) and the debug methods report 0."""
+        if method & MethodFlags.Ppermute:
+            return self.exchange_bytes_total()
+        return 0
 
     # --- fused step builder ---------------------------------------------------
     def make_step(self, kernel: StepKernel, overlap: bool = True, donate: bool = True):

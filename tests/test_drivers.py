@@ -1,0 +1,125 @@
+"""Tier-2: every bin/ driver runs end-to-end on the fake 8-device mesh and
+emits its reference-parity CSV (SURVEY.md §2.4 inventory)."""
+
+import math
+
+import pytest
+
+
+def _capture(capsys):
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out, "driver printed nothing"
+    return out
+
+
+def test_jacobi3d(capsys):
+    from stencil_tpu.bin.jacobi3d import main
+
+    assert main(["--iters", "3", "--no-weak-scale", "16", "16", "16"]) == 0
+    row = _capture(capsys)[-1].split(",")
+    # jacobi3d,<methods>,ranks,devCount,x,y,z,min,trimean (jacobi3d.cu:378-379)
+    assert row[0] == "jacobi3d"
+    assert row[4:7] == ["16", "16", "16"]
+    assert float(row[7]) > 0 and float(row[8]) > 0
+
+
+def test_weak(capsys):
+    from stencil_tpu.bin.weak import main
+
+    assert main(["12", "12", "12", "2"]) == 0
+    row = _capture(capsys)[-1].split(",")
+    assert row[0] == "weak"
+    assert len(row) == 23  # weak.cu:184-188 column layout
+    x, y, z, s = (int(v) for v in row[2:6])
+    assert x * y * z == s
+    assert int(row[6]) > 0  # exchange bytes ride the collective column
+    assert float(row[21]) > 0  # accumulated exchange seconds
+
+
+def test_strong(capsys):
+    from stencil_tpu.bin.strong import main
+
+    assert main(["16", "16", "16", "2"]) == 0
+    row = _capture(capsys)[-1].split(",")
+    assert row[0] == "strong"
+    assert len(row) == 23
+    assert row[2:5] == ["16", "16", "16"]  # NOT weak-scaled
+
+
+def test_weak_exchange(capsys):
+    from stencil_tpu.bin.weak_exchange import main
+
+    assert main(["12", "12", "12", "2"]) == 0
+    row = _capture(capsys)[-1].split(",")
+    assert row[0] == "weak"
+    assert float(row[-1]) > 0  # single wall-clock elapsed
+
+
+def test_astaroth_sim(capsys):
+    from stencil_tpu.bin.astaroth_sim import main
+
+    assert main(["--x", "16", "--y", "16", "--z", "16", "--iters", "2"]) == 0
+    row = _capture(capsys)[-1].split(",")
+    assert row[0] == "astaroth"
+    assert float(row[7]) > 0
+
+
+def test_bench_exchange(capsys):
+    from stencil_tpu.bin.bench_exchange import main
+
+    assert main(["--iters", "2", "--x", "12", "--y", "12", "--z", "12"]) == 0
+    out = _capture(capsys)
+    assert out[0] == "name,count,trimean (S),trimean (B/s),stddev,min,avg,max"
+    assert len(out) == 6  # header + 5 radius configs (bench_exchange.cu:121-195)
+    for line in out[1:]:
+        cols = line.split(",")
+        assert float(cols[2]) > 0 and float(cols[3]) > 0
+
+
+def test_bench_qap(capsys):
+    from stencil_tpu.bin.bench_qap import main
+
+    assert main(["--iters", "1", "--max-size", "6", "--exact-below", "5"]) == 0
+    out = _capture(capsys)
+    assert out[0] == "blkdiag"
+    assert out[1] == "size CRAFT(s) cost exact(s) cost"
+    # exact solve rows: heuristic cost must be >= exact cost (optimality)
+    for line in out[2:4]:
+        cols = line.split()
+        if cols[3] != "-":
+            assert float(cols[2]) >= float(cols[4]) - 1e-9
+
+
+def test_pingpong(capsys):
+    from stencil_tpu.bin.pingpong import main
+
+    assert main(["--min", "2", "--max", "4", "--iters", "2"]) == 0
+    out = _capture(capsys)
+    for line in out:
+        name, *times = line.split()
+        assert "-" in name
+        assert len(times) == 3
+        assert all(float(t) > 0 for t in times)
+
+
+def test_bench_alltoallv(capsys):
+    from stencil_tpu.bin.bench_alltoallv import main
+
+    assert main(["--iters", "1", "--scale", "0.001"]) == 0
+    out = _capture(capsys)
+    assert "bw" in out and "time" in out and "stencil" in out
+    assert "All-to-all 8MiB" in out
+    assert "Local 1GiB Remote 100M" in out
+
+
+def test_measure_buf_exchange(capsys):
+    from stencil_tpu.bin.measure_buf_exchange import main
+
+    assert main(["--iters", "2", "--sub-iters", "1", "--init-mib", "0.05"]) == 0
+    out = _capture(capsys)
+    assert out[0] == "x"
+    assert "final x (MiB)" in out
+    final = out[out.index("final x (MiB)") + 1 :]
+    vals = [float(v) for line in final for v in line.split()]
+    assert any(v > 0 for v in vals)
+    assert all(not math.isnan(v) for v in vals)

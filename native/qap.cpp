@@ -1,0 +1,126 @@
+// Native QAP solvers for topology-aware placement.
+//
+// Parity target: qap::solve / qap::solve_catch (reference
+// include/stencil/qap.hpp:50-172), exposed through a C ABI consumed via
+// ctypes (stencil_tpu/parallel/native_qap.py).  Semantics match the Python
+// spec in stencil_tpu/parallel/qap.py exactly, including the 0 * inf = 0
+// guard (qap.hpp:15-20); the Python versions remain the always-available
+// fallback.
+//
+// Build: make -C native   (produces libstencil_native.so)
+
+#include <algorithm>
+#include <cstdint>
+#include <limits>
+#include <numeric>
+#include <vector>
+
+namespace {
+
+// qap.hpp:15-20: avoid 0 * inf = nan
+inline double cost_product(double we, double de) {
+  if (we == 0.0 || de == 0.0) {
+    return 0.0;
+  }
+  return we * de;
+}
+
+inline double cost(const double *w, const double *d, const int *f, int n) {
+  double total = 0.0;
+  for (int a = 0; a < n; ++a) {
+    const double *wrow = w + static_cast<std::int64_t>(a) * n;
+    const double *drow = d + static_cast<std::int64_t>(f[a]) * n;
+    for (int b = 0; b < n; ++b) {
+      total += cost_product(wrow[b], drow[f[b]]);
+    }
+  }
+  return total;
+}
+
+// Sum of all cost terms touching rows/cols i and j, evaluated with f[i]=fi
+// and f[j]=fj (every other assignment as in f).  delta = affected(after) -
+// affected(before); O(n) per candidate swap (qap.hpp:108-147 incremental
+// update).
+inline double affected(const double *w, const double *d, const int *f, int n,
+                       int i, int j, int fi, int fj) {
+  const std::int64_t N = n;
+  double s = 0.0;
+  for (int k = 0; k < n; ++k) {
+    if (k == i || k == j) {
+      continue;
+    }
+    const int fk = f[k];
+    s += cost_product(w[i * N + k], d[fi * N + fk]);
+    s += cost_product(w[j * N + k], d[fj * N + fk]);
+    s += cost_product(w[k * N + i], d[fk * N + fi]);
+    s += cost_product(w[k * N + j], d[fk * N + fj]);
+  }
+  s += cost_product(w[i * N + i], d[fi * N + fi]);
+  s += cost_product(w[i * N + j], d[fi * N + fj]);
+  s += cost_product(w[j * N + i], d[fj * N + fi]);
+  s += cost_product(w[j * N + j], d[fj * N + fj]);
+  return s;
+}
+
+} // namespace
+
+extern "C" {
+
+double stencil_qap_cost(const double *w, const double *d, const int *f,
+                        int n) {
+  return cost(w, d, f, n);
+}
+
+// Exact exhaustive search over all permutations (qap.hpp:50-75).  O(n!).
+double stencil_qap_solve(const double *w, const double *d, int n, int *f_out) {
+  std::vector<int> perm(n);
+  std::iota(perm.begin(), perm.end(), 0);
+  std::vector<int> best = perm;
+  double best_cost = cost(w, d, perm.data(), n);
+  while (std::next_permutation(perm.begin(), perm.end())) {
+    const double c = cost(w, d, perm.data(), n);
+    if (c < best_cost) {
+      best_cost = c;
+      best = perm;
+    }
+  }
+  std::copy(best.begin(), best.end(), f_out);
+  return best_cost;
+}
+
+// CRAFT 2-opt: repeatedly take the best single-pair swap until no swap
+// improves (qap.hpp:77-172).
+double stencil_qap_solve_catch(const double *w, const double *d, int n,
+                               int *f_out) {
+  std::vector<int> f(n);
+  std::iota(f.begin(), f.end(), 0);
+  double best_cost = cost(w, d, f.data(), n);
+
+  bool improved = true;
+  while (improved) {
+    improved = false;
+    int bi = -1, bj = -1;
+    double impr_cost = best_cost;
+    for (int i = 0; i < n; ++i) {
+      for (int j = i + 1; j < n; ++j) {
+        const double before = affected(w, d, f.data(), n, i, j, f[i], f[j]);
+        const double after = affected(w, d, f.data(), n, i, j, f[j], f[i]);
+        const double c = best_cost + (after - before);
+        if (c < impr_cost) {
+          impr_cost = c;
+          bi = i;
+          bj = j;
+          improved = true;
+        }
+      }
+    }
+    if (improved) {
+      std::swap(f[bi], f[bj]);
+      best_cost = impr_cost;
+    }
+  }
+  std::copy(f.begin(), f.end(), f_out);
+  return best_cost;
+}
+
+} // extern "C"

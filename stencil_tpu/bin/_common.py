@@ -84,6 +84,40 @@ def fit_to_mesh(x: int, y: int, z: int, radius, devices=None):
     )
 
 
+def make_edge_transfer(mesh, n_dev: int, src: int, dst: int, n_elems: int):
+    """Jitted single-edge ``lax.ppermute`` src->dst of ``n_elems`` f32 per
+    shard, plus a matching input array.  The shared point-to-point primitive
+    under pingpong / bench-alltoallv / measure-buf-exchange."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("d"))
+
+    @jax.jit
+    def go(x):
+        def f(blk):
+            return lax.ppermute(blk, "d", [(src, dst)])
+
+        return jax.shard_map(f, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x)
+
+    x = jax.device_put(jnp.ones((n_elems * n_dev,), jnp.float32), sharding)
+    return go, x
+
+
+def measure_edge(mesh, n_dev: int, src: int, dst: int, nbytes: int, n_iters: int) -> float:
+    """Seconds per single-edge transfer of ``nbytes`` (compile excluded)."""
+    import time
+
+    go, x = make_edge_transfer(mesh, n_dev, src, dst, max(int(nbytes) // 4, 1))
+    go(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        y = go(x)
+    y.block_until_ready()
+    return (time.perf_counter() - t0) / n_iters
+
+
 class WallTimer:
     def __enter__(self):
         self.t0 = time.perf_counter()

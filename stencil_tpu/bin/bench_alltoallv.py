@@ -14,28 +14,12 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-
-def _edge_transfer(mesh, n_dev: int, src: int, dst: int, n_elems: int):
-    """Jitted single-edge ppermute src->dst of n_elems f32 per shard."""
-    sharding = NamedSharding(mesh, P("d"))
-
-    @jax.jit
-    def go(x):
-        def f(blk):
-            return lax.ppermute(blk, "d", [(src, dst)])
-
-        return jax.shard_map(f, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x)
-
-    x = jax.device_put(jnp.ones((n_elems * n_dev,), jnp.float32), sharding)
-    return go, x
+from stencil_tpu.bin import _common
 
 
 def measure_pairs(devices, comm: np.ndarray, n_iters: int):
@@ -48,14 +32,7 @@ def measure_pairs(devices, comm: np.ndarray, n_iters: int):
         for j in range(n):
             if i == j or comm[i, j] == 0:
                 continue
-            n_elems = max(int(comm[i, j]) // 4, 1)
-            go, x = _edge_transfer(mesh, n, i, j, n_elems)
-            go(x).block_until_ready()  # compile
-            t0 = time.perf_counter()
-            for _ in range(n_iters):
-                y = go(x)
-            y.block_until_ready()
-            dt = (time.perf_counter() - t0) / n_iters
+            dt = _common.measure_edge(mesh, n, i, j, int(comm[i, j]), n_iters)
             times[i, j] = dt
             total += dt
     return times, total

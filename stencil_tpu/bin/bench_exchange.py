@@ -35,14 +35,12 @@ def bench(n_iters: int, n_quants: int, ext, radius: Radius):
     stats = Statistics()
     dd.exchange()  # compile
     dd.swap()
-    for a in dd._curr.values():
-        a.block_until_ready()
+    dd.block_until_ready()
     for _ in range(n_iters):
         t0 = time.perf_counter()
         dd.exchange()
         dd.swap()
-        for a in dd._curr.values():
-            a.block_until_ready()
+        dd.block_until_ready()
         stats.insert(time.perf_counter() - t0)
     return stats, dd.exchange_bytes_total()
 

@@ -1,0 +1,99 @@
+"""bench-pack — pack/unpack kernel bandwidth.
+
+Parity target: reference bin/bench_pack.cu: for a 512^3 float quantity with
+radius 3, time packing/unpacking the x, y, and z face slabs on one chip
+(bench_pack.cu:91-107).  Output format matches the reference
+(``<ext> <dir> <bytes> <packTime> <unpackTime>``), plus a GB/s column (the
+BASELINE.md metric).  ``--backend pallas`` uses the explicit-DMA Pallas
+kernels; ``xla`` (default) the fused slice/concat path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.geometry import LocalSpec
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.ops.pack import (
+    make_pack_fn,
+    make_pack_fn_pallas,
+    make_unpack_fn,
+    make_unpack_fn_pallas,
+)
+
+
+def bench(sz: Dim3, direction: Dim3, n_iters: int, backend: str, interpret: bool):
+    """Returns (bytes, pack_s_per_iter, unpack_s_per_iter)."""
+    spec = LocalSpec.make(sz, Dim3(0, 0, 0), Radius.constant(3))
+    raw = tuple(spec.raw_size())
+    rng = np.random.default_rng(0)
+    block = jnp.asarray(rng.random(raw), dtype=jnp.float32)
+
+    if backend == "pallas":
+        pack, plan = make_pack_fn_pallas(spec, [direction], jnp.float32, interpret=interpret)
+        unpack, _ = make_unpack_fn_pallas(spec, [direction], jnp.float32, interpret=interpret)
+        packed = pack(block)
+        jax.block_until_ready(packed)
+
+        def run_pack():
+            jax.block_until_ready(pack(block))
+
+        def run_unpack():
+            jax.block_until_ready(unpack(block, packed))
+
+    else:
+        pack, plan = make_pack_fn(spec, [direction], [jnp.float32])
+        unpack, _ = make_unpack_fn(spec, [direction], [jnp.float32])
+        packed = pack([block])
+        jax.block_until_ready(packed)
+        # unpack donates its block argument; feed it a fresh copy each call
+        proto = block
+
+        def run_pack():
+            jax.block_until_ready(pack([block]))
+
+        def run_unpack():
+            jax.block_until_ready(unpack(packed, [proto + 0]))
+
+    run_pack()
+    run_unpack()  # compile both outside timing
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        run_pack()
+    pack_t = (time.perf_counter() - t0) / n_iters
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        run_unpack()
+    unpack_t = (time.perf_counter() - t0) / n_iters
+    return plan.size, pack_t, unpack_t
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("bench-pack")
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--size", type=int, default=512)
+    p.add_argument("--backend", choices=["xla", "pallas"], default="xla")
+    p.add_argument(
+        "--interpret",
+        action="store_true",
+        help="run pallas kernels in interpreter mode (CPU testing)",
+    )
+    args = p.parse_args(argv)
+
+    ext = Dim3(args.size, args.size, args.size)
+    for d in (Dim3(1, 0, 0), Dim3(0, 1, 0), Dim3(0, 0, 1)):
+        nbytes, pack_t, unpack_t = bench(ext, d, args.iters, args.backend, args.interpret)
+        gbps = nbytes / min(pack_t, unpack_t) / 1e9
+        print(f"{ext} {d} {nbytes} {pack_t:g} {unpack_t:g} {gbps:.2f}GB/s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

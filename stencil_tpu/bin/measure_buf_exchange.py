@@ -13,35 +13,15 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+from stencil_tpu.bin import _common
+from stencil_tpu.bin._common import measure_edge
 
 MiB = 1024 * 1024
-
-
-def measure_edge(mesh, n_dev: int, src: int, dst: int, nbytes: int, n_iters: int) -> float:
-    sharding = NamedSharding(mesh, P("d"))
-    n_elems = max(int(nbytes) // 4, 1)
-
-    @jax.jit
-    def go(x):
-        def f(blk):
-            return lax.ppermute(blk, "d", [(src, dst)])
-
-        return jax.shard_map(f, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x)
-
-    x = jax.device_put(jnp.ones((n_elems * n_dev,), jnp.float32), sharding)
-    go(x).block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
-        y = go(x)
-    y.block_until_ready()
-    return (time.perf_counter() - t0) / n_iters
 
 
 def print_mat(label: str, m: np.ndarray, fmt) -> None:

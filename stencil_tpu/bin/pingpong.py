@@ -25,20 +25,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def pingpong_times(devices, min_n: int, max_n: int, n_iters: int):
-    """For each adjacent device pair, time a there-and-back ppermute per size."""
+    """For each adjacent device pair, time a there-and-back single-edge
+    ppermute (src -> dst -> src) per message size."""
     n_dev = len(devices)
     mesh = Mesh(np.array(devices), ("d",))
-    sharding = NamedSharding(mesh, P("d"))
 
-    @jax.jit
-    def rt(x):
-        def f(blk):
-            # dev k sends to k+1, which returns it: one full round trip
-            fwd = lax.ppermute(blk, "d", [(k, (k + 1) % n_dev) for k in range(n_dev)])
-            back = lax.ppermute(fwd, "d", [(k, (k - 1) % n_dev) for k in range(n_dev)])
-            return back
+    def make_rt(src: int, dst: int, n_elems: int):
+        sharding = NamedSharding(mesh, P("d"))
 
-        return jax.shard_map(f, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x)
+        @jax.jit
+        def rt(x):
+            def f(blk):
+                fwd = lax.ppermute(blk, "d", [(src, dst)])
+                return lax.ppermute(fwd, "d", [(dst, src)])
+
+            return jax.shard_map(f, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x)
+
+        x = jax.device_put(jnp.zeros((n_elems * n_dev,), jnp.float32), sharding)
+        return rt, x
 
     rows = []
     for pair in range(max(n_dev - 1, 1)):
@@ -46,8 +50,7 @@ def pingpong_times(devices, min_n: int, max_n: int, n_iters: int):
         times = []
         for p in range(min_n, max_n + 1):
             nbytes = 1 << p
-            n_elems = max(nbytes // 4, 1) * n_dev
-            x = jax.device_put(jnp.zeros((n_elems,), jnp.float32), sharding)
+            rt, x = make_rt(src, dst, max(nbytes // 4, 1))
             rt(x).block_until_ready()  # compile
             t0 = time.perf_counter()
             for _ in range(n_iters):

@@ -44,15 +44,13 @@ def main(argv=None) -> int:
     # one warm call so jit compilation stays out of the wall clock
     dd.exchange()
     dd.swap()
-    for a in dd._curr.values():
-        a.block_until_ready()
+    dd.block_until_ready()
 
     t0 = time.perf_counter()
     for _ in range(args.n_iters):
         dd.exchange()
         dd.swap()
-    for a in dd._curr.values():
-        a.block_until_ready()
+    dd.block_until_ready()
     elapsed = time.perf_counter() - t0
 
     if jax.process_index() == 0:

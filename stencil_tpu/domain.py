@@ -351,6 +351,11 @@ class DistributedDomain:
         if self._exchange_stats:
             self.stats.time_swap += time.perf_counter() - t0
 
+    def block_until_ready(self) -> None:
+        """Wait for all in-flight device work on the current buffers."""
+        for a in self._curr.values():
+            a.block_until_ready()
+
     def get_curr(self, h: DataHandle) -> jax.Array:
         return self._curr[h.name]
 

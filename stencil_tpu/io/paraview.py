@@ -41,7 +41,8 @@ def write_paraview(dd, prefix: str, zero_nans: bool = True) -> None:
             ]
             vals = np.transpose(block, (2, 1, 0)).ravel().astype(np.float64)
             if zero_nans:
-                vals = np.nan_to_num(vals, nan=0.0)
+                # zero NaN only; keep +-inf verbatim so divergence stays visible
+                vals = np.nan_to_num(vals, nan=0.0, posinf=np.inf, neginf=-np.inf)
             cols.append(vals)
         table = np.column_stack(cols)
         header = "Z,Y,X" + "".join(f",{c}" for c in names)

@@ -76,6 +76,22 @@ def test_bench_exchange(capsys):
         assert float(cols[2]) > 0 and float(cols[3]) > 0
 
 
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+def test_bench_pack(capsys, backend):
+    from stencil_tpu.bin.bench_pack import main
+
+    argv = ["--iters", "1", "--size", "12", "--backend", backend]
+    if backend == "pallas":
+        argv.append("--interpret")
+    assert main(argv) == 0
+    out = _capture(capsys)
+    assert len(out) == 3  # x, y, z faces (bench_pack.cu:91-107)
+    for line in out:
+        cols = line.split()
+        assert int(cols[2]) == 12 * 12 * 3 * 4  # face slab bytes, r=3 f32
+        assert float(cols[3]) > 0 and float(cols[4]) > 0
+
+
 def test_bench_qap(capsys):
     from stencil_tpu.bin.bench_qap import main
 

@@ -1,0 +1,68 @@
+"""Tier-1: native C++ QAP solvers agree with the pure-Python spec."""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from stencil_tpu.parallel.qap import qap_cost, qap_solve, qap_solve_catch
+
+native = pytest.importorskip(
+    "stencil_tpu.parallel.native_qap", reason="native library unavailable"
+)
+
+
+def _mats(n, seed):
+    rng = np.random.default_rng(seed)
+    return rng.random((n, n)) * 10, rng.random((n, n)) * 10
+
+
+@pytest.mark.parametrize("n", [2, 3, 5, 6])
+def test_exact_matches_python(n):
+    w, d = _mats(n, n)
+    pf, pc = qap_solve(w, d)
+    nf, nc = native.qap_solve(w, d)
+    assert nc == pytest.approx(pc)
+    # permutation may differ only if degenerate; cost of each must agree
+    assert native.qap_cost(w, d, nf) == pytest.approx(qap_cost(w, d, pf))
+
+
+@pytest.mark.parametrize("n", [4, 8, 12])
+def test_catch_matches_python(n):
+    w, d = _mats(n, 100 + n)
+    pf, pc = qap_solve_catch(w, d)
+    nf, nc = native.qap_solve_catch(w, d)
+    # both are deterministic best-swap hill climbers from identity: identical
+    assert nf == pf
+    assert nc == pytest.approx(pc)
+
+
+def test_catch_with_inf_distances():
+    # the 0 * inf = 0 guard (qap.hpp:15-20)
+    w = np.array([[0.0, 5.0], [5.0, 0.0]])
+    d = np.array([[0.0, np.inf], [np.inf, 0.0]])
+    f, c = native.qap_solve_catch(w, d)
+    assert c == np.inf  # nonzero weight on infinite distance
+    w0 = np.zeros((2, 2))
+    f, c = native.qap_solve(w0, d)
+    assert c == 0.0  # all weights zero: inf distances contribute nothing
+
+
+def test_cost_identity_permutation():
+    w, d = _mats(5, 7)
+    f = list(range(5))
+    assert native.qap_cost(w, d, f) == pytest.approx(qap_cost(w, d, f))
+
+
+def test_native_beats_python_speed():
+    """The point of the native path: exact n=8 should be far faster."""
+    import time
+
+    w, d = _mats(8, 42)
+    t0 = time.perf_counter()
+    native.qap_solve(w, d)
+    native_t = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    qap_solve(w, d)
+    python_t = time.perf_counter() - t0
+    assert native_t < python_t

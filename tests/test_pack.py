@@ -1,0 +1,160 @@
+"""Tier-1/2: packed-buffer layout math and pack/unpack round trips.
+
+Ports reference test/test_cuda_packer.cu (the 264-byte multi-radius exact
+size check and packer/unpacker size agreement) and test_cuda_pack.cu's
+slab-content checks, for both the XLA and the Pallas (interpret-mode)
+backends.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.geometry import LocalSpec
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.ops.pack import (
+    PackPlan,
+    make_pack_fn,
+    make_pack_fn_pallas,
+    make_unpack_fn,
+    make_unpack_fn_pallas,
+    next_align_of,
+)
+
+
+def test_next_align_of():
+    # reference test_cuda_align.cu:5-16
+    assert next_align_of(0, 4) == 0
+    assert next_align_of(1, 4) == 4
+    assert next_align_of(4, 4) == 4
+    assert next_align_of(5, 8) == 8
+    assert next_align_of(80, 8) == 80
+
+
+def _multi_radius_spec():
+    # test_cuda_packer.cu:51-60: 3x4x5, +x radius 2, -x radius 1
+    r = Radius.constant(0)
+    r.set_dir(Dim3(1, 0, 0), 2)
+    r.set_dir(Dim3(-1, 0, 0), 1)
+    return LocalSpec.make(Dim3(3, 4, 5), Dim3(0, 0, 0), r)
+
+
+def test_plan_264_bytes():
+    """The exact expected-size case (test_cuda_packer.cu:74-92):
+    +x message, quantities f32/char/f64: 80 + 20 -> align 104 + 160 = 264."""
+    spec = _multi_radius_spec()
+    plan = PackPlan.make(spec, [Dim3(1, 0, 0)], [4, 1, 8])
+    assert plan.size == 264
+    assert [s.offset for s in plan.slots] == [0, 80, 104]
+    # send +x packs the -x-radius-sized region: 1x4x5
+    assert all(s.extent == Dim3(1, 4, 5) for s in plan.slots)
+
+
+def test_plan_sorted_and_symmetric():
+    """Messages are sorted by direction and packer/unpacker sizes agree
+    (test_cuda_packer.cu:25-39)."""
+    spec = LocalSpec.make(Dim3(3, 4, 5), Dim3(0, 0, 0), Radius.constant(2))
+    dirs = [Dim3(-1, -1, -1), Dim3(1, 1, 1), Dim3(0, 1, 1), Dim3(0, 0, 1)]
+    plan = PackPlan.make(spec, dirs, [4, 1, 8])
+    assert [s.direction for s in plan.slots[::3]] == sorted(Dim3.of(d) for d in dirs)
+    plan2 = PackPlan.make(spec, dirs, [4, 1, 8])
+    assert plan.size == plan2.size
+    # offsets strictly increase and stay aligned
+    for s in plan.slots:
+        assert s.offset % s.itemsize == 0
+
+
+def test_plan_zero_size_raises():
+    spec = LocalSpec.make(Dim3(3, 4, 5), Dim3(0, 0, 0), Radius.constant(0))
+    with pytest.raises(ValueError):
+        PackPlan.make(spec, [Dim3(1, 0, 0)], [4])
+
+
+def _filled_blocks(spec, dtypes, seed=0):
+    """Raw blocks with distinct values everywhere (halos included)."""
+    rng = np.random.default_rng(seed)
+    raw = tuple(spec.raw_size())
+    return [jnp.asarray(rng.random(raw), dtype=t) for t in dtypes]
+
+
+@pytest.mark.parametrize(
+    "dirs",
+    [
+        [Dim3(1, 0, 0)],
+        [Dim3(-1, 0, 0), Dim3(1, 0, 0)],
+        [Dim3(0, 1, 0), Dim3(0, 0, -1), Dim3(1, 1, 1)],
+    ],
+)
+def test_xla_roundtrip(dirs):
+    """pack(src) -> unpack(dst): dst's -d halo must equal src's +d interior
+    slab for every message and quantity (the exchange invariant)."""
+    spec = LocalSpec.make(Dim3(6, 5, 4), Dim3(0, 0, 0), Radius.constant(2))
+    dtypes = [jnp.float32, jnp.float64]
+    pack, plan = make_pack_fn(spec, dirs, dtypes)
+    unpack, _ = make_unpack_fn(spec, dirs, dtypes)
+
+    src = _filled_blocks(spec, dtypes, seed=1)
+    dst = _filled_blocks(spec, dtypes, seed=2)
+    src_np = [np.asarray(b) for b in src]
+
+    buf = pack(src)
+    assert buf.shape == (plan.size,)
+    out = unpack(buf, [b for b in dst])
+
+    for slot in plan.slots:
+        p, e = slot.pos, slot.extent
+        want = src_np[slot.quantity][p.x : p.x + e.x, p.y : p.y + e.y, p.z : p.z + e.z]
+        u = slot.unpack_pos
+        got = np.asarray(out[slot.quantity])[
+            u.x : u.x + e.x, u.y : u.y + e.y, u.z : u.z + e.z
+        ]
+        np.testing.assert_array_equal(got, want)
+
+
+def test_xla_roundtrip_multi_radius():
+    """Uneven +x/-x radii: the -dir extent convention must hold byte-for-byte
+    (test_cuda_packer.cu:94-116)."""
+    spec = _multi_radius_spec()
+    dirs = [Dim3(-1, 0, 0), Dim3(1, 0, 0)]
+    dtypes = [jnp.float32, jnp.uint8, jnp.float64]
+    pack, plan = make_pack_fn(spec, dirs, dtypes)
+    unpack, _ = make_unpack_fn(spec, dirs, dtypes)
+    src = _filled_blocks(spec, dtypes, seed=3)
+    src_np = [np.asarray(b) for b in src]
+    out = unpack(pack(src), _filled_blocks(spec, dtypes, seed=4))
+    # +x message extent (1,4,5); -x message extent (2,4,5)
+    by_dir = {tuple(s.direction): s for s in plan.slots if s.quantity == 0}
+    assert by_dir[(1, 0, 0)].extent == Dim3(1, 4, 5)
+    assert by_dir[(-1, 0, 0)].extent == Dim3(2, 4, 5)
+    for slot in plan.slots:
+        p, e, u = slot.pos, slot.extent, slot.unpack_pos
+        want = src_np[slot.quantity][p.x : p.x + e.x, p.y : p.y + e.y, p.z : p.z + e.z]
+        got = np.asarray(out[slot.quantity])[
+            u.x : u.x + e.x, u.y : u.y + e.y, u.z : u.z + e.z
+        ]
+        np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("direction", [Dim3(1, 0, 0), Dim3(0, -1, 0), Dim3(0, 0, 1)])
+def test_pallas_roundtrip_faces(direction):
+    """Pallas DMA backend (interpret mode on CPU) matches the XLA backend for
+    face slabs."""
+    spec = LocalSpec.make(Dim3(8, 8, 8), Dim3(0, 0, 0), Radius.constant(3))
+    pack, plan = make_pack_fn_pallas(spec, [direction], jnp.float32, interpret=True)
+    unpack, _ = make_unpack_fn_pallas(spec, [direction], jnp.float32, interpret=True)
+
+    src = _filled_blocks(spec, [jnp.float32], seed=5)[0]
+    dst = _filled_blocks(spec, [jnp.float32], seed=6)[0]
+    src_np = np.asarray(src)
+
+    slabs = pack(src)
+    out = np.asarray(unpack(dst, slabs))
+    (slot,) = plan.slots
+    p, e, u = slot.pos, slot.extent, slot.unpack_pos
+    want = src_np[p.x : p.x + e.x, p.y : p.y + e.y, p.z : p.z + e.z]
+    got = out[u.x : u.x + e.x, u.y : u.y + e.y, u.z : u.z + e.z]
+    np.testing.assert_array_equal(got, want)
+    # untouched cells keep dst's values
+    interior = np.asarray(dst)[3:-3, 3:-3, 3:-3]
+    np.testing.assert_array_equal(out[3:-3, 3:-3, 3:-3], interior)

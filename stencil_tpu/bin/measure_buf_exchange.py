@@ -18,7 +18,6 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from stencil_tpu.bin import _common
 from stencil_tpu.bin._common import measure_edge
 
 MiB = 1024 * 1024

@@ -114,44 +114,13 @@ class LocalSpec:
     # --- interior/exterior split (src/stencil.cu:567-666) --------------------
     def interior(self) -> Rect3:
         """Compute region shrunk per-direction so no point reads a halo cell."""
-        com = self.compute_region()
-        lo = [com.lo.x, com.lo.y, com.lo.z]
-        hi = [com.hi.x, com.hi.y, com.hi.z]
-        for d in DIRECTIONS_26:
-            rad = self.radius.dir(d)
-            for axis in range(3):
-                if d[axis] < 0:
-                    lo[axis] = max(com.lo[axis] + rad, lo[axis])
-                elif d[axis] > 0:
-                    hi[axis] = min(com.hi[axis] - rad, hi[axis])
-        return Rect3(Dim3(*lo), Dim3(*hi))
+        return shrink_by_radius(self.compute_region(), self.radius)
 
     def exterior(self) -> List[Rect3]:
         """Non-overlapping face slabs covering compute-region minus interior,
         via the reference's slide-in construction (src/stencil.cu:616-666):
         order +x, +y, +z, -x, -y, -z."""
-        int_reg = self.interior()
-        com = self.compute_region()
-        clo = [com.lo.x, com.lo.y, com.lo.z]
-        chi = [com.hi.x, com.hi.y, com.hi.z]
-        ilo = [int_reg.lo.x, int_reg.lo.y, int_reg.lo.z]
-        ihi = [int_reg.hi.x, int_reg.hi.y, int_reg.hi.z]
-        out: List[Rect3] = []
-        for axis in range(3):  # +x, +y, +z
-            if ihi[axis] != chi[axis]:
-                lo = list(clo)
-                hi = list(chi)
-                lo[axis] = ihi[axis]
-                out.append(Rect3(Dim3(*lo), Dim3(*hi)))
-                chi[axis] = ihi[axis]
-        for axis in range(3):  # -x, -y, -z
-            if ilo[axis] != clo[axis]:
-                lo = list(clo)
-                hi = list(chi)
-                hi[axis] = ilo[axis]
-                out.append(Rect3(Dim3(*lo), Dim3(*hi)))
-                clo[axis] = ilo[axis]
-        return out
+        return exterior_of(self.compute_region(), self.interior())
 
     # --- local (allocation-relative) views -----------------------------------
     def to_local(self, r: Rect3) -> Rect3:
@@ -170,6 +139,47 @@ class LocalSpec:
 
     def interior_slices(self):
         return self.local_slices(self.compute_region())
+
+
+def shrink_by_radius(com: Rect3, radius: Radius) -> Rect3:
+    """Shrink a region per-direction so no point inside reads outside it
+    (the interior construction, src/stencil.cu:567-610; also the per-sub-step
+    valid-region shrink under a halo multiplier)."""
+    lo = [com.lo.x, com.lo.y, com.lo.z]
+    hi = [com.hi.x, com.hi.y, com.hi.z]
+    for d in DIRECTIONS_26:
+        rad = radius.dir(d)
+        for axis in range(3):
+            if d[axis] < 0:
+                lo[axis] = max(com.lo[axis] + rad, lo[axis])
+            elif d[axis] > 0:
+                hi[axis] = min(com.hi[axis] - rad, hi[axis])
+    return Rect3(Dim3(*lo), Dim3(*hi))
+
+
+def exterior_of(com: Rect3, int_reg: Rect3) -> List[Rect3]:
+    """Non-overlapping face slabs covering ``com`` minus ``int_reg`` via the
+    slide-in construction (src/stencil.cu:616-666): +x, +y, +z, -x, -y, -z."""
+    clo = [com.lo.x, com.lo.y, com.lo.z]
+    chi = [com.hi.x, com.hi.y, com.hi.z]
+    ilo = [int_reg.lo.x, int_reg.lo.y, int_reg.lo.z]
+    ihi = [int_reg.hi.x, int_reg.hi.y, int_reg.hi.z]
+    out: List[Rect3] = []
+    for axis in range(3):  # +x, +y, +z
+        if ihi[axis] != chi[axis]:
+            lo = list(clo)
+            hi = list(chi)
+            lo[axis] = ihi[axis]
+            out.append(Rect3(Dim3(*lo), Dim3(*hi)))
+            chi[axis] = ihi[axis]
+    for axis in range(3):  # -x, -y, -z
+        if ilo[axis] != clo[axis]:
+            lo = list(clo)
+            hi = list(chi)
+            hi[axis] = ilo[axis]
+            out.append(Rect3(Dim3(*lo), Dim3(*hi)))
+            clo[axis] = ilo[axis]
+    return out
 
 
 def exchange_bytes(spec: LocalSpec, itemsizes) -> int:

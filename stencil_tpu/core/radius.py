@@ -57,6 +57,18 @@ class Radius:
         d[axis] = sign
         return self.dir(*d)
 
+    def scaled(self, k: int) -> "Radius":
+        """A radius with every direction multiplied by ``k`` — the halo
+        multiplier (reference README.md future list: exchange every k steps
+        with k*r-wide halos)."""
+        out = Radius()
+        for sx in (-1, 0, 1):
+            for sy in (-1, 0, 1):
+                for sz in (-1, 0, 1):
+                    if (sx, sy, sz) != (0, 0, 0):
+                        out.set_dir(Dim3(sx, sy, sz), self.dir(sx, sy, sz) * k)
+        return out
+
     # --- mutators (radius.hpp:46-79) -----------------------------------------
     def set_face(self, r: int) -> "Radius":
         for d in FACE_DIRECTIONS:

@@ -22,10 +22,12 @@ TPU design (not a translation):
   ppermutes, so XLA overlaps communication with compute — the job of the
   reference's entire sender/recver state-machine zoo.
 
-v0 constraint: the global size must divide evenly by the mesh (XLA shards are
-equal); the reference's ±1-cell remainders (partition.hpp:83-114) are handled
-by requiring divisible sizes (pad-and-mask is the planned extension, SURVEY.md
-§7 "Hard parts").
+Uneven global sizes (the reference's ±1-cell remainders, partition.hpp:83-114)
+are handled by pad-and-mask: every shard is padded to ``ceil(size/dim)`` (XLA
+shards must be equal), the LAST shard on a padded axis owns the remainder, the
+exchange uses per-shard dynamic slab offsets so halos carry VALID cells across
+the periodic wrap, and host gather/scatter masks the padding (SURVEY.md §7
+"Hard parts").
 """
 
 from __future__ import annotations
@@ -114,11 +116,14 @@ class BlockInfo:
 
     def coords(self):
         """Global (x, y, z) coordinate arrays for the region, broadcastable
-        to the region's shape."""
+        to the region's shape.  Wrapped periodically: regions extended into
+        the halo shell (halo-multiplier sub-steps) see the coordinates of the
+        cells the shell mirrors."""
         s = self.region
-        cx = self.origin[0] + jnp.arange(s[0].start, s[0].stop)
-        cy = self.origin[1] + jnp.arange(s[1].start, s[1].stop)
-        cz = self.origin[2] + jnp.arange(s[2].start, s[2].stop)
+        g = self.global_size
+        cx = (self.origin[0] + jnp.arange(s[0].start, s[0].stop)) % g.x
+        cy = (self.origin[1] + jnp.arange(s[1].start, s[1].stop)) % g.y
+        cz = (self.origin[2] + jnp.arange(s[2].start, s[2].stop)) % g.z
         return cx[:, None, None], cy[None, :, None], cz[None, None, :]
 
 
@@ -139,10 +144,13 @@ class DistributedDomain:
         self.mesh: Optional[Mesh] = None
         self.placement: Optional[Placement] = None
         self._spec: Optional[LocalSpec] = None
+        self._valid_last: Tuple[Optional[int], Optional[int], Optional[int]] = (None, None, None)
         self._curr: Dict[str, jax.Array] = {}
         self._next: Dict[str, jax.Array] = {}
         self._exchange_fn = None
         self._exchange_count = 0
+        self._halo_mult = 1
+        self._shell_radius: Optional[Radius] = None
         self.stats = DomainStats()
         # blocking per-exchange timing costs a device sync per call, exactly
         # like the reference's barrier-per-call EXCHANGE_STATS (default OFF,
@@ -171,6 +179,18 @@ class DistributedDomain:
         """Analog of set_gpus (stencil.hpp:306): restrict/order the devices."""
         self._devices = devices
 
+    def set_halo_multiplier(self, k: int) -> None:
+        """Allocate ``k * radius``-wide shells and run ``k`` compute sub-steps
+        per exchange — fewer, larger messages (the reference's future-work
+        item, README.md:157-176; BASELINE.md config #5).  A step built by
+        ``make_step`` then advances ``k`` iterations per call."""
+        assert k >= 1
+        assert not self._realized, "set_halo_multiplier must precede realize()"
+        self._halo_mult = int(k)
+
+    def halo_multiplier(self) -> int:
+        return self._halo_mult
+
     def size(self) -> Dim3:
         return self._size
 
@@ -187,17 +207,27 @@ class DistributedDomain:
         self.mesh, self.placement = make_mesh(self._size, self._radius, devices, self._strategy)
         self.stats.time_placement = time.perf_counter() - t0
         dim = self.placement.dim()
+        # uneven sizes: pad each axis's shard to ceil(size/dim) and mask (the
+        # reference's +-1-cell remainders, partition.hpp:83-114; XLA shards
+        # must be equal).  The LAST shard on a padded axis owns
+        # ``size - (dim-1)*n_pad`` valid cells.
+        n = Dim3(*(-(-self._size[ax] // dim[ax]) for ax in range(3)))
+        vlast = []
         for ax in range(3):
-            if self._size[ax] % dim[ax] != 0:
-                raise ValueError(
-                    f"global size {self._size} not divisible by mesh {dim} on axis "
-                    f"{ax}; pad the domain (uneven shards are a planned extension)"
-                )
-        n = self._size // dim
-        r = self._radius
-        if min(n.x, n.y, n.z) < max(r.lo().x, r.lo().y, r.lo().z, r.hi().x, r.hi().y, r.hi().z):
-            raise ValueError(f"subdomain {n} smaller than radius shell")
-        # all shards share one spec (even split); per-shard origin varies
+            v = self._size[ax] - (dim[ax] - 1) * n[ax]
+            vlast.append(None if v == n[ax] else v)
+        self._valid_last = tuple(vlast)
+        # the SHELL radius is the user radius times the halo multiplier: the
+        # allocation, the exchange, and the bytes model all use it; compute
+        # sub-steps shrink by the user radius
+        r = self._shell_radius = self._radius.scaled(self._halo_mult)
+        max_r = max(r.lo().x, r.lo().y, r.lo().z, r.hi().x, r.hi().y, r.hi().z)
+        min_valid = min(v if v is not None else n[ax] for ax, v in enumerate(vlast))
+        if min(n.x, n.y, n.z) < max_r or min_valid < max_r:
+            raise ValueError(
+                f"subdomain {n} (last-shard valid {vlast}) smaller than radius shell"
+            )
+        # all shards share one spec (padded equal split); per-shard origin varies
         self._spec = LocalSpec.make(n, Dim3(0, 0, 0), r)
         raw = self._spec.raw_size()
         sharding = NamedSharding(self.mesh, P(*MESH_AXES))
@@ -208,7 +238,7 @@ class DistributedDomain:
             self._next[h.name] = jnp.zeros(gshape, dtype=h.dtype, device=sharding)
         self.stats.time_realize = time.perf_counter() - t0
         t0 = time.perf_counter()
-        self._exchange_fn = make_exchange_fn(self.mesh, r)
+        self._exchange_fn = make_exchange_fn(self.mesh, r, valid_last=self._valid_last)
         self.stats.time_plan = time.perf_counter() - t0
         # eager trace+compile of the exchange — the analog of the reference's
         # sender/recver creation + CUDA-Graph capture (src/stencil.cu:385-529);
@@ -237,6 +267,19 @@ class DistributedDomain:
     def num_subdomains(self) -> int:
         return self.placement.dim().flatten()
 
+    def shard_valid(self, idx) -> Dim3:
+        """Valid (unpadded) interior extent of the shard at mesh index ``idx``
+        (last shard on a padded axis owns the remainder)."""
+        idx = Dim3.of(idx)
+        dim = self.placement.dim()
+        n = self._spec.sz
+        return Dim3(
+            *(
+                (self._valid_last[ax] if (idx[ax] == dim[ax] - 1 and self._valid_last[ax] is not None) else n[ax])
+                for ax in range(3)
+            )
+        )
+
     # --- data movement --------------------------------------------------------
     def _to_raw_global(self, interior: np.ndarray, dtype) -> np.ndarray:
         """Scatter a (X,Y,Z) user-domain array into the shell-carrying global
@@ -244,20 +287,21 @@ class DistributedDomain:
         dim = self.placement.dim()
         n = self._spec.sz
         raw = self._spec.raw_size()
-        lo = self._radius.lo()
+        lo = self._shell_radius.lo()
         out = np.zeros((dim.x * raw.x, dim.y * raw.y, dim.z * raw.z), dtype=dtype)
         for ix in range(dim.x):
             for iy in range(dim.y):
                 for iz in range(dim.z):
+                    v = self.shard_valid((ix, iy, iz))
                     src = interior[
-                        ix * n.x : (ix + 1) * n.x,
-                        iy * n.y : (iy + 1) * n.y,
-                        iz * n.z : (iz + 1) * n.z,
+                        ix * n.x : ix * n.x + v.x,
+                        iy * n.y : iy * n.y + v.y,
+                        iz * n.z : iz * n.z + v.z,
                     ]
                     out[
-                        ix * raw.x + lo.x : ix * raw.x + lo.x + n.x,
-                        iy * raw.y + lo.y : iy * raw.y + lo.y + n.y,
-                        iz * raw.z + lo.z : iz * raw.z + lo.z + n.z,
+                        ix * raw.x + lo.x : ix * raw.x + lo.x + v.x,
+                        iy * raw.y + lo.y : iy * raw.y + lo.y + v.y,
+                        iz * raw.z + lo.z : iz * raw.z + lo.z + v.z,
                     ] = src
         return out
 
@@ -265,19 +309,20 @@ class DistributedDomain:
         dim = self.placement.dim()
         n = self._spec.sz
         raw = self._spec.raw_size()
-        lo = self._radius.lo()
+        lo = self._shell_radius.lo()
         out = np.zeros((self._size.x, self._size.y, self._size.z), dtype=raw_arr.dtype)
         for ix in range(dim.x):
             for iy in range(dim.y):
                 for iz in range(dim.z):
+                    v = self.shard_valid((ix, iy, iz))
                     out[
-                        ix * n.x : (ix + 1) * n.x,
-                        iy * n.y : (iy + 1) * n.y,
-                        iz * n.z : (iz + 1) * n.z,
+                        ix * n.x : ix * n.x + v.x,
+                        iy * n.y : iy * n.y + v.y,
+                        iz * n.z : iz * n.z + v.z,
                     ] = raw_arr[
-                        ix * raw.x + lo.x : ix * raw.x + lo.x + n.x,
-                        iy * raw.y + lo.y : iy * raw.y + lo.y + n.y,
-                        iz * raw.z + lo.z : iz * raw.z + lo.z + n.z,
+                        ix * raw.x + lo.x : ix * raw.x + lo.x + v.x,
+                        iy * raw.y + lo.y : iy * raw.y + lo.y + v.y,
+                        iz * raw.z + lo.z : iz * raw.z + lo.z + v.z,
                     ]
         return out
 
@@ -306,7 +351,7 @@ class DistributedDomain:
         shell, for analytic whole-domain fields)."""
         n = self._spec.sz
         raw = self._spec.raw_size()
-        lo = self._radius.lo()
+        lo = self._shell_radius.lo()
         mesh_shape = tuple(self.mesh.shape[a] for a in MESH_AXES)
 
         def per_shard(block):
@@ -382,6 +427,11 @@ class DistributedDomain:
     def make_step(self, kernel: StepKernel, overlap: bool = True, donate: bool = True):
         """Build ``step(curr) -> next`` fusing exchange + compute.
 
+        With a halo multiplier ``k`` (``set_halo_multiplier``) each built step
+        is a MACRO step: one exchange of ``k*r``-wide shells followed by ``k``
+        compute sub-steps over shrinking valid regions — ``step(curr, s)``
+        advances ``s*k`` iterations with ``s`` exchanges.
+
         ``overlap=True`` splits interior/exterior (reference overlap pipeline,
         jacobi3d.cu:265-337): the interior update reads no halo cells and so
         carries no dependency on the ppermutes — XLA schedules them
@@ -389,23 +439,55 @@ class DistributedDomain:
         exchange (jacobi3d.cu:312-329 --no-overlap).
         """
         assert self._realized
+        from stencil_tpu.core.geometry import exterior_of, shrink_by_radius
+
         n = self._spec.sz
-        r = self._radius
-        lo = r.lo()
+        r_user = self._radius
+        shell = self._shell_radius
+        mult = self._halo_mult
+        lo = shell.lo()  # allocation offset of the interior
         mesh_shape = tuple(self.mesh.shape[a] for a in MESH_AXES)
         names = [h.name for h in self._handles]
 
-        interior_rect = self._spec.interior()
-        exterior_rects = self._spec.exterior()
+        # pre-exchange interior: cells whose USER-radius stencil support lies
+        # entirely inside the valid interior
+        interior_rect = shrink_by_radius(self._spec.compute_region(), r_user)
+        # padded axes: the last shard's valid cells end before n_pad, so the
+        # overlap-safe interior (computable before the exchange) must also
+        # stop short of the earliest possible halo: shrink the high side by
+        # the padding width.  Non-last shards lose some overlap (their cells
+        # there become exterior, computed after the exchange) — correct for
+        # every shard, conservative for most.
+        pad_shrink = [
+            (n[ax] - self._valid_last[ax]) if self._valid_last[ax] is not None else 0
+            for ax in range(3)
+        ]
+        if any(pad_shrink):
+            hi = Dim3(
+                *(
+                    max(interior_rect.hi[ax] - pad_shrink[ax], interior_rect.lo[ax])
+                    for ax in range(3)
+                )
+            )
+            interior_rect = Rect3(interior_rect.lo, hi)
+
+        # halo-multiplier sub-step regions (interior-local coords): the region
+        # valid after the exchange is the full shell; each sub-step shrinks it
+        # by the user radius, landing exactly on the interior after ``mult``
+        # sub-steps.  mult == 1 -> a single region == the compute region.
+        shell_rect = Rect3(Dim3(0, 0, 0) - shell.lo(), n + shell.hi())
+        sub_regions: List[Rect3] = []
+        cur_rect = shell_rect
+        for _ in range(mult):
+            cur_rect = shrink_by_radius(cur_rect, r_user)
+            sub_regions.append(cur_rect)
 
         def rect_to_slices(rect: Rect3):
             return tuple(slice(rect.lo[ax], rect.hi[ax]) for ax in range(3))
 
-        full_region = rect_to_slices(self._spec.compute_region())
-
         def region_update(blocks, region, origin):
             views = {k: ShardView(b, lo, region) for k, b in blocks.items()}
-            info = BlockInfo(origin, n, self._size, r, region)
+            info = BlockInfo(origin, n, self._size, r_user, region)
             return kernel(views, info)
 
         def write_region(new_block, region, vals):
@@ -415,36 +497,43 @@ class DistributedDomain:
             return new_block.at[idx].set(vals)
 
         def one_step(blocks):
+            """One macro step: exchange + ``mult`` compute sub-steps."""
             origin = tuple(
                 lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)
             )
-            new_blocks = dict(blocks)
             if overlap:
-                # 1) interior: no halo reads -> no ppermute dependency
+                # interior: no shell reads -> no ppermute dependency; XLA
+                # schedules it concurrently with the collective
                 int_region = rect_to_slices(interior_rect)
                 int_vals = region_update(blocks, int_region, origin)
-                # 2) exchange
-                exch = {k: halo_exchange_shard(b, r, mesh_shape) for k, b in blocks.items()}
-                # 3) exterior slabs read the fresh halos
-                ext_vals = [
-                    (rect_to_slices(rect), region_update(exch, rect_to_slices(rect), origin))
-                    for rect in exterior_rects
-                ]
-                for k in names:
-                    nb = new_blocks[k]
-                    if k in int_vals:
-                        nb = write_region(nb, int_region, int_vals[k])
-                    for region, vals in ext_vals:
+            exch = {
+                k: halo_exchange_shard(
+                    b, shell, mesh_shape, valid_last=self._valid_last
+                )
+                for k, b in blocks.items()
+            }
+            cur = exch
+            for j, rect in enumerate(sub_regions):
+                region = rect_to_slices(rect)
+                new_blocks = dict(cur)
+                if j == 0 and overlap:
+                    for k in names:
+                        if k in int_vals:
+                            new_blocks[k] = write_region(new_blocks[k], int_region, int_vals[k])
+                    # exterior slabs (incl. shell extensions) read fresh halos
+                    for ext_rect in exterior_of(rect, interior_rect):
+                        ext_region = rect_to_slices(ext_rect)
+                        vals = region_update(cur, ext_region, origin)
+                        for k in names:
+                            if k in vals:
+                                new_blocks[k] = write_region(new_blocks[k], ext_region, vals[k])
+                else:
+                    vals = region_update(cur, region, origin)
+                    for k in names:
                         if k in vals:
-                            nb = write_region(nb, region, vals[k])
-                    new_blocks[k] = nb
-            else:
-                exch = {k: halo_exchange_shard(b, r, mesh_shape) for k, b in blocks.items()}
-                vals = region_update(exch, full_region, origin)
-                for k in names:
-                    if k in vals:
-                        new_blocks[k] = write_region(new_blocks[k], full_region, vals[k])
-            return new_blocks
+                            new_blocks[k] = write_region(new_blocks[k], region, vals[k])
+                cur = new_blocks
+            return cur
 
         def per_shard(steps, *blocks_tuple):
             blocks = dict(zip(names, blocks_tuple))

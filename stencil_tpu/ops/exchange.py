@@ -27,7 +27,7 @@ same-GPU ``PeerAccessSender`` kernels (tx_cuda.cuh:39-104).
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -54,12 +54,21 @@ def halo_exchange_shard(
     radius: Radius,
     mesh_shape: Tuple[int, int, int],
     axis_names: Sequence[str] = MESH_AXES,
+    valid_last: Optional[Tuple[Optional[int], Optional[int], Optional[int]]] = None,
 ) -> jax.Array:
     """Fill the halo shell of one shell-carrying shard.  Must run inside
     ``shard_map`` over a mesh with ``axis_names``.
 
     ``block`` has extent ``interior + r_lo + r_hi`` per axis; the interior
     occupies ``[r_lo, r_lo + n)``.
+
+    ``valid_last`` supports uneven global sizes via pad-and-mask (the
+    reference's +-1-cell remainders, partition.hpp:83-114): entry ``a`` is the
+    number of VALID interior cells in the LAST shard of axis ``a`` (``None``
+    = axis divides evenly).  On a padded axis every shard sends the top slab
+    of its own valid cells and writes the received +axis halo right after its
+    valid cells — slab positions become per-shard ``lax.dynamic_slice``
+    offsets derived from ``axis_index``; the collective itself is unchanged.
     """
     for axis in range(3):
         r_lo = radius.axis(axis, -1)  # my low-side halo width
@@ -69,39 +78,71 @@ def halo_exchange_shard(
         name = axis_names[axis]
         n_dev = mesh_shape[axis]
         size = block.shape[axis]  # raw extent on this axis
-        interior_hi = size - r_hi  # one past last interior element
+        n_pad = size - r_lo - r_hi  # per-shard (padded) interior width
+        v_last = valid_last[axis] if valid_last is not None else None
+        uneven = v_last is not None and v_last != n_pad
 
         def axslice(lo, hi):
             idx = [slice(None)] * block.ndim
             idx[axis] = slice(lo, hi)
             return tuple(idx)
 
+        def dyn_starts(start):
+            s = [jnp.int32(0)] * block.ndim
+            s[axis] = start
+            return tuple(s)
+
+        def slab_sizes(w):
+            s = list(block.shape)
+            s[axis] = w
+            return tuple(s)
+
+        if uneven:
+            idx = lax.axis_index(name)
+            n_valid = jnp.where(idx == n_dev - 1, v_last, n_pad).astype(jnp.int32)
         updates = []
         if r_lo > 0:
-            # my low halo [0, r_lo) <- -axis neighbor's interior top slab,
-            # width r_lo (the message traveling +axis has extent radius(-axis))
-            slab = block[axslice(interior_hi - r_lo, interior_hi)]
+            # my low halo [0, r_lo) <- -axis neighbor's top slab of VALID
+            # interior, width r_lo (message traveling +axis has extent
+            # radius(-axis))
+            if uneven:
+                # top r_lo rows of my valid interior: [n_valid, n_valid+r_lo)
+                # in allocation coords (interior starts at r_lo)
+                slab = lax.dynamic_slice(block, dyn_starts(n_valid), slab_sizes(r_lo))
+            else:
+                slab = block[axslice(n_pad, r_lo + n_pad)]
             recv = _shift_from_low(slab, name, n_dev)
-            updates.append((axslice(0, r_lo), recv))
+            updates.append((axslice(0, r_lo), None, recv))
         if r_hi > 0:
-            # my high halo [interior_hi, size) <- +axis neighbor's interior
-            # bottom slab, width r_hi
+            # my high halo <- +axis neighbor's interior bottom slab, width
+            # r_hi, written right after MY valid cells
             slab = block[axslice(r_lo, r_lo + r_hi)]
             recv = _shift_from_high(slab, name, n_dev)
-            updates.append((axslice(interior_hi, size), recv))
-        for idx, val in updates:
-            block = block.at[idx].set(val)
+            if uneven:
+                updates.append((None, dyn_starts(r_lo + n_valid), recv))
+            else:
+                updates.append((axslice(r_lo + n_pad, size), None, recv))
+        for sl, starts, val in updates:
+            if starts is not None:
+                block = lax.dynamic_update_slice(block, val, starts)
+            else:
+                block = block.at[sl].set(val)
     return block
 
 
-def make_exchange_fn(mesh: Mesh, radius: Radius, ndim_extra: int = 0):
+def make_exchange_fn(
+    mesh: Mesh,
+    radius: Radius,
+    ndim_extra: int = 0,
+    valid_last: Optional[Tuple[Optional[int], Optional[int], Optional[int]]] = None,
+):
     """Build a jitted exchange over a pytree of shell-carrying global arrays.
 
     Returns ``exchange(arrays) -> arrays`` where each array is sharded
     ``P('x','y','z')`` on its last three dims (``ndim_extra`` leading batch/
     quantity dims are unsharded).  Donates its input: the halo write is
     in-place in HBM, like the reference filling halos inside the existing
-    allocation.
+    allocation.  ``valid_last`` — see ``halo_exchange_shard``.
     """
     mesh_shape = tuple(mesh.shape[a] for a in MESH_AXES)
     spec = P(*([None] * ndim_extra), *MESH_AXES)
@@ -115,11 +156,15 @@ def make_exchange_fn(mesh: Mesh, radius: Radius, ndim_extra: int = 0):
                 if ndim_extra:
                     bb = b.reshape((-1,) + b.shape[-3:])
                     bb = jax.vmap(
-                        lambda v: halo_exchange_shard(v, radius, mesh_shape)
+                        lambda v: halo_exchange_shard(
+                            v, radius, mesh_shape, valid_last=valid_last
+                        )
                     )(bb)
                     out.append(bb.reshape(b.shape))
                 else:
-                    out.append(halo_exchange_shard(b, radius, mesh_shape))
+                    out.append(
+                        halo_exchange_shard(b, radius, mesh_shape, valid_last=valid_last)
+                    )
             return tuple(out)
 
         leaves, treedef = jax.tree.flatten(arrays)

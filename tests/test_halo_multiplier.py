@@ -1,0 +1,80 @@
+"""Tier-2: halo multiplier — exchange every k steps with k*r-wide shells.
+
+The reference's future-work item (README.md:157-176; BASELINE.md config #5).
+Gold check: a model with multiplier k advancing s macro-steps must equal the
+plain model advancing s*k steps — communication cadence must not change the
+math.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.models.astaroth import AstarothSim
+from stencil_tpu.models.jacobi import Jacobi3D
+
+
+def test_scaled_radius():
+    r = Radius.face_edge_corner(3, 2, 1)
+    s = r.scaled(2)
+    assert s.x(1) == 6 and s.dir(1, 1, 0) == 4 and s.dir(1, 1, 1) == 2
+    assert r.x(1) == 3  # original untouched
+
+
+@pytest.mark.parametrize("mult", [2, 3])
+@pytest.mark.parametrize("overlap", [True, False])
+def test_jacobi_multiplier_matches_plain(mult, overlap):
+    size = (24, 24, 24)
+    plain = Jacobi3D(*size, overlap=overlap)
+    plain.realize()
+
+    fat = Jacobi3D(*size, overlap=overlap)
+    fat.dd.set_halo_multiplier(mult)
+    fat.realize()
+    assert fat.dd.halo_multiplier() == mult
+
+    macro = 2
+    plain.step(macro * mult)
+    fat.step(macro)  # each built step advances mult iterations
+    np.testing.assert_allclose(plain.temperature(), fat.temperature(), rtol=1e-6)
+
+
+def test_jacobi_multiplier_uneven():
+    size = (17, 18, 19)
+    plain = Jacobi3D(*size)
+    plain.realize()
+    fat = Jacobi3D(*size)
+    fat.dd.set_halo_multiplier(2)
+    fat.realize()
+    plain.step(4)
+    fat.step(2)
+    np.testing.assert_allclose(plain.temperature(), fat.temperature(), rtol=1e-6)
+
+
+def test_astaroth_multiplier_radius3():
+    size = (28, 28, 28)  # shard 14 >= shell 2*3
+    plain = AstarothSim(*size)
+    plain.realize()
+    fat = AstarothSim(*size)
+    fat.dd.set_halo_multiplier(2)
+    fat.realize()
+    plain.step(2)
+    fat.step(1)
+    np.testing.assert_allclose(plain.field(), fat.field(), rtol=1e-5, atol=1e-6)
+
+
+def test_multiplier_exchange_bytes_grow():
+    """k*r shells move more bytes per exchange (but k times fewer exchanges)."""
+    from stencil_tpu.domain import DistributedDomain
+
+    a = DistributedDomain(24, 24, 24)
+    a.set_radius(1)
+    a.add_data("q")
+    a.realize()
+    b = DistributedDomain(24, 24, 24)
+    b.set_radius(1)
+    b.set_halo_multiplier(2)
+    b.add_data("q")
+    b.realize()
+    assert b.exchange_bytes_total() > a.exchange_bytes_total()

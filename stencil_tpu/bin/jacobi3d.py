@@ -32,6 +32,9 @@ def main(argv=None) -> int:
     p.add_argument("--iters", "-n", type=int, default=30, help="number of iterations")
     p.add_argument("--period", "-q", type=int, default=-1, help="iterations between checkpoints")
     p.add_argument("--no-weak-scale", action="store_true", help="use x y z as the global size directly")
+    p.add_argument("--trace", default=None, help="write a jax.profiler trace to this dir (nsys analog)")
+    p.add_argument("--plan", action="store_true", help="dump the communication plan (plan_<rank>.txt analog)")
+    p.add_argument("--halo-multiplier", type=int, default=1, help="exchange every k steps with k*r halos")
     p.add_argument("x", type=int, nargs="?", default=512)
     p.add_argument("y", type=int, nargs="?", default=512)
     p.add_argument("z", type=int, nargs="?", default=512)
@@ -48,17 +51,7 @@ def main(argv=None) -> int:
 
     checkpoint_period = args.period if args.period > 0 else max(args.iters // 10, 1)
 
-    # mesh divisibility: fit to the nearest multiple if weak scaling produced
-    # an indivisible size (reference subdomains may be uneven; XLA shards may
-    # not)
-    from stencil_tpu.core.radius import Radius
-
-    r = Radius.constant(0)
-    r.set_face(1)
-    fx, fy, fz = _common.fit_to_mesh(x, y, z, r)
-    if (fx, fy, fz) != (x, y, z):
-        print(f"adjusted global size {x} {y} {z} -> {fx} {fy} {fz}", file=sys.stderr)
-        x, y, z = fx, fy, fz
+    # uneven sizes are padded-and-masked by realize(); no size adjustment
     model = Jacobi3D(
         x,
         y,
@@ -67,21 +60,30 @@ def main(argv=None) -> int:
         strategy=_common.parse_strategy(args),
         methods=_common.parse_methods(args),
     )
+    if args.halo_multiplier > 1:
+        model.dd.set_halo_multiplier(args.halo_multiplier)
     model.realize()
+    if args.plan:
+        print(f"wrote {model.dd.write_plan(args.prefix + 'plan')}", file=sys.stderr)
 
     iter_time = Statistics()
     model.step()  # compile outside the timed loop
     model.block_until_ready()
 
-    for it in range(args.iters):
-        t0 = time.perf_counter()
-        model.step()
-        model.block_until_ready()
-        iter_time.insert(time.perf_counter() - t0)
-        if args.paraview and it % checkpoint_period == 0:
-            from stencil_tpu.io.paraview import write_paraview
+    from stencil_tpu.utils.profiling import trace
 
-            write_paraview(model.dd, f"{args.prefix}jacobi3d_{it}")
+    with trace(args.trace):
+        for it in range(args.iters):
+            t0 = time.perf_counter()
+            model.step()
+            model.block_until_ready()
+            # a macro step advances halo_multiplier iterations; the CSV stays
+            # per-iteration so rows are comparable across multipliers
+            iter_time.insert((time.perf_counter() - t0) / args.halo_multiplier)
+            if args.paraview and it % checkpoint_period == 0:
+                from stencil_tpu.io.paraview import write_paraview
+
+                write_paraview(model.dd, f"{args.prefix}jacobi3d_{it}")
     if args.paraview:
         from stencil_tpu.io.paraview import write_paraview
 

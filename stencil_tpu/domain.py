@@ -415,6 +415,30 @@ class DistributedDomain:
         per_dom = exchange_bytes(self._spec, [h.dtype.itemsize for h in self._handles])
         return per_dom * self.num_subdomains()
 
+    def write_plan(self, prefix: str = "plan") -> str:
+        """Dump the communication plan — the analog of the reference's
+        per-rank ``plan_<rank>.txt`` (src/stencil.cu:259-353): the placement
+        report plus one line per direction with the message extent and bytes
+        (all riding the collective exchange).  Returns the path written."""
+        from stencil_tpu.core.direction_map import DIRECTIONS_26
+        from stencil_tpu.core.geometry import exchange_bytes
+
+        lines = [self.placement.report(), "", "# messages (method=ppermute for all)"]
+        spec = self._spec
+        itemsizes = [h.dtype.itemsize for h in self._handles]
+        for d in DIRECTIONS_26:
+            if spec.radius.dir(-d) == 0:
+                continue
+            ext = spec.halo_extent(-d)
+            nbytes = ext.flatten() * sum(itemsizes)
+            lines.append(f"dir={d} extent={ext} bytes={nbytes} method=ppermute")
+        total = exchange_bytes(spec, itemsizes)
+        lines.append(f"# total bytes per exchange per subdomain: {total}")
+        path = f"{prefix}_{jax.process_index()}.txt"
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return path
+
     def exchange_bytes_for_method(self, method: MethodFlags) -> int:
         """Per-method byte counter (src/stencil.cu:6-25).  On TPU every
         transport is the collective path, so all bytes are attributed to
@@ -504,8 +528,9 @@ class DistributedDomain:
             if overlap:
                 # interior: no shell reads -> no ppermute dependency; XLA
                 # schedules it concurrently with the collective
-                int_region = rect_to_slices(interior_rect)
-                int_vals = region_update(blocks, int_region, origin)
+                with jax.named_scope("interior_compute"):
+                    int_region = rect_to_slices(interior_rect)
+                    int_vals = region_update(blocks, int_region, origin)
             exch = {
                 k: halo_exchange_shard(
                     b, shell, mesh_shape, valid_last=self._valid_last

@@ -41,12 +41,14 @@ from stencil_tpu.parallel.mesh import MESH_AXES
 
 def _shift_from_low(x, axis_name: str, n: int):
     """Each shard receives the value held by its -1 neighbor (data moves +)."""
-    return lax.ppermute(x, axis_name, [(k, (k + 1) % n) for k in range(n)])
+    with jax.named_scope(f"halo_ppermute_{axis_name}_from_low"):  # NVTX analog
+        return lax.ppermute(x, axis_name, [(k, (k + 1) % n) for k in range(n)])
 
 
 def _shift_from_high(x, axis_name: str, n: int):
     """Each shard receives the value held by its +1 neighbor (data moves -)."""
-    return lax.ppermute(x, axis_name, [(k, (k - 1) % n) for k in range(n)])
+    with jax.named_scope(f"halo_ppermute_{axis_name}_from_high"):
+        return lax.ppermute(x, axis_name, [(k, (k - 1) % n) for k in range(n)])
 
 
 def halo_exchange_shard(

@@ -1,0 +1,31 @@
+"""Tracing/profiling hooks.
+
+Parity target: the reference's NVTX ranges around every phase
+(src/stencil.cu:672-861, tx_cuda.cuh sends, jacobi3d.cu:276) and its
+nsys/nvprof workflow (README.md:60-96).  On TPU the equivalents are
+``jax.profiler`` traces (viewable in TensorBoard/XProf) and
+``jax.named_scope`` annotations, which label the corresponding regions in the
+compiled HLO and in profile timelines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def annotate(name: str):
+    """Label a region in traces and HLO (the NVTX range analog)."""
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Capture a ``jax.profiler`` trace into ``log_dir`` (no-op when None).
+    View with TensorBoard's profile plugin / xprof."""
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield

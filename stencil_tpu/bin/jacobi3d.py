@@ -35,6 +35,12 @@ def main(argv=None) -> int:
     p.add_argument("--trace", default=None, help="write a jax.profiler trace to this dir (nsys analog)")
     p.add_argument("--plan", action="store_true", help="dump the communication plan (plan_<rank>.txt analog)")
     p.add_argument("--halo-multiplier", type=int, default=1, help="exchange every k steps with k*r halos")
+    p.add_argument(
+        "--kernel-impl",
+        choices=["pallas", "jnp"],
+        default="pallas",
+        help="pallas plane-streaming kernel (fast) or XLA slices",
+    )
     p.add_argument("x", type=int, nargs="?", default=512)
     p.add_argument("y", type=int, nargs="?", default=512)
     p.add_argument("z", type=int, nargs="?", default=512)
@@ -52,6 +58,15 @@ def main(argv=None) -> int:
     checkpoint_period = args.period if args.period > 0 else max(args.iters // 10, 1)
 
     # uneven sizes are padded-and-masked by realize(); no size adjustment
+    kernel_impl = args.kernel_impl
+    if kernel_impl == "pallas" and (args.halo_multiplier > 1 or args.no_overlap):
+        # the pallas path is a fused radius-1 single-exchange kernel; the
+        # halo multiplier and the overlap on/off comparison only exist in the
+        # generic make_step machinery
+        print(
+            "halo-multiplier/--no-overlap force --kernel-impl jnp", file=sys.stderr
+        )
+        kernel_impl = "jnp"
     model = Jacobi3D(
         x,
         y,
@@ -59,6 +74,8 @@ def main(argv=None) -> int:
         overlap=not args.no_overlap,
         strategy=_common.parse_strategy(args),
         methods=_common.parse_methods(args),
+        kernel_impl=kernel_impl,
+        interpret=jax.default_backend() == "cpu",
     )
     if args.halo_multiplier > 1:
         model.dd.set_halo_multiplier(args.halo_multiplier)

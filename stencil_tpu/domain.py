@@ -238,7 +238,16 @@ class DistributedDomain:
             self._next[h.name] = jnp.zeros(gshape, dtype=h.dtype, device=sharding)
         self.stats.time_realize = time.perf_counter() - t0
         t0 = time.perf_counter()
-        self._exchange_fn = make_exchange_fn(self.mesh, r, valid_last=self._valid_last)
+        if self._methods == MethodFlags.AllGather:
+            # debug method: validates the ppermute path (stencil.hpp:29-41
+            # method selection); even (unpadded) sizes only
+            from stencil_tpu.ops.exchange import make_exchange_fn_allgather
+
+            if any(v is not None for v in self._valid_last):
+                raise ValueError("AllGather debug exchange requires even sizes")
+            self._exchange_fn = make_exchange_fn_allgather(self.mesh, r, self._spec, dim)
+        else:
+            self._exchange_fn = make_exchange_fn(self.mesh, r, valid_last=self._valid_last)
         self.stats.time_plan = time.perf_counter() - t0
         # eager trace+compile of the exchange — the analog of the reference's
         # sender/recver creation + CUDA-Graph capture (src/stencil.cu:385-529);
@@ -430,7 +439,7 @@ class DistributedDomain:
             if spec.radius.dir(-d) == 0:
                 continue
             ext = spec.halo_extent(-d)
-            nbytes = ext.flatten() * sum(itemsizes)
+            nbytes = sum(spec.halo_bytes(-d, s) for s in itemsizes)
             lines.append(f"dir={d} extent={ext} bytes={nbytes} method=ppermute")
         total = exchange_bytes(spec, itemsizes)
         lines.append(f"# total bytes per exchange per subdomain: {total}")

@@ -132,6 +132,45 @@ def halo_exchange_shard(
     return block
 
 
+def make_exchange_fn_allgather(mesh: Mesh, radius: Radius, spec, dim):
+    """Debug exchange: reconstruct every shard's raw block (interior + filled
+    shell) as wrapped windows of the LOGICAL global field, letting XLA insert
+    whatever collectives the resharding needs (effectively all-gathers).
+    Obviously slow — exists to validate the ppermute path, the role the
+    reference's ``MethodFlags`` method selection plays for benchmarking
+    alternatives (stencil.hpp:29-41; SURVEY.md §7 "MethodFlags").  Even
+    (unpadded) sizes only.
+    """
+    raw = spec.raw_size()
+    n = spec.sz
+    lo = radius.lo()
+    sharding = NamedSharding(mesh, P(*MESH_AXES))
+
+    def axis_indices(ax: int):
+        size = dim[ax] * n[ax]  # logical extent
+        parts = [
+            (i * n[ax] - lo[ax] + jnp.arange(raw[ax])) % size for i in range(dim[ax])
+        ]
+        return jnp.concatenate(parts)
+
+    idx = [axis_indices(ax) for ax in range(3)]
+
+    @jax.jit
+    def exchange(arrays):
+        def one(arr):
+            # extract the logical field from the shell-carrying layout
+            g = arr.reshape(dim[0], raw[0], dim[1], raw[1], dim[2], raw[2])
+            g = g[:, lo[0] : lo[0] + n[0], :, lo[1] : lo[1] + n[1], :, lo[2] : lo[2] + n[2]]
+            logical = g.reshape(dim[0] * n[0], dim[1] * n[1], dim[2] * n[2])
+            # every raw cell is a wrapped-window read of the logical field
+            out = logical[idx[0]][:, idx[1]][:, :, idx[2]]
+            return jax.lax.with_sharding_constraint(out, sharding)
+
+        return jax.tree.map(one, arrays)
+
+    return exchange
+
+
 def make_exchange_fn(
     mesh: Mesh,
     radius: Radius,

@@ -111,6 +111,25 @@ def test_exchange_face_edge_corner():
     _run_exchange_check(Radius.face_edge_corner(2, 2, 2))
 
 
+def test_allgather_method_matches_ppermute():
+    """MethodFlags.AllGather (debug path) produces identical halos to the
+    production ppermute exchange (the role method selection plays in the
+    reference, stencil.hpp:29-41)."""
+    from stencil_tpu.utils.config import MethodFlags
+
+    results = []
+    for method in (MethodFlags.All, MethodFlags.AllGather):
+        dd = DistributedDomain(16, 16, 16)
+        dd.set_radius(Radius.face_edge_corner(2, 1, 1))
+        dd.set_methods(method)
+        h = dd.add_data("d0")
+        dd.realize()
+        dd.init_by_coords(h, lambda x, y, z: x * 37.0 + y * 5.0 + z)
+        dd.exchange()
+        results.append(dd.raw_to_host(h))
+    np.testing.assert_array_equal(results[0], results[1])
+
+
 def test_exchange_multi_quantity():
     """N fields share one exchange (packer.cuh:52-69 joint exchange analog)."""
     dd = DistributedDomain(16, 16, 16)

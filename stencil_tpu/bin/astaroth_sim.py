@@ -38,6 +38,12 @@ def main(argv=None) -> int:
     p.add_argument("--kernel", action="store_true")
     p.add_argument("--no-overlap", action="store_true")
     p.add_argument("--trivial", action="store_true")
+    p.add_argument(
+        "--kernel-impl",
+        choices=["pallas", "jnp"],
+        default="pallas",
+        help="pallas plane-streaming kernel (fast) or XLA slices",
+    )
     args = p.parse_args(argv)
 
     num_subdoms = len(jax.devices())
@@ -45,6 +51,10 @@ def main(argv=None) -> int:
     x, y, z = _common.fit_to_mesh(args.x, args.y, args.z, Radius.constant(3))
     print(f"domain: {x},{y},{z}", file=sys.stderr)
 
+    kernel_impl = args.kernel_impl
+    if args.no_overlap and kernel_impl == "pallas":
+        print("--no-overlap forces --kernel-impl jnp", file=sys.stderr)
+        kernel_impl = "jnp"
     sim = AstarothSim(
         x,
         y,
@@ -52,6 +62,8 @@ def main(argv=None) -> int:
         num_quantities=args.quantities,
         overlap=not args.no_overlap,
         strategy=_common.parse_strategy(args),
+        kernel_impl=kernel_impl,
+        interpret=jax.default_backend() == "cpu",
     )
     sim.realize()
     sim.step()  # compile

@@ -151,6 +151,7 @@ class DistributedDomain:
         self._exchange_count = 0
         self._halo_mult = 1
         self._shell_radius: Optional[Radius] = None
+        self._force_dim: Optional[Dim3] = None
         self.stats = DomainStats()
         # blocking per-exchange timing costs a device sync per call, exactly
         # like the reference's barrier-per-call EXCHANGE_STATS (default OFF,
@@ -179,6 +180,13 @@ class DistributedDomain:
         """Analog of set_gpus (stencil.hpp:306): restrict/order the devices."""
         self._devices = devices
 
+    def set_partition(self, px: int, py: int, pz: int) -> None:
+        """Force the process grid instead of deriving it (manual partition,
+        the reference's future-work item, README.md:157-176).  The product
+        must equal the device count at realize()."""
+        assert not self._realized
+        self._force_dim = Dim3(px, py, pz)
+
     def set_halo_multiplier(self, k: int) -> None:
         """Allocate ``k * radius``-wide shells and run ``k`` compute sub-steps
         per exchange — fewer, larger messages (the reference's future-work
@@ -204,7 +212,9 @@ class DistributedDomain:
         devices = list(self._devices) if self._devices is not None else jax.devices()
         self.stats.time_topo = time.perf_counter() - t0
         t0 = time.perf_counter()
-        self.mesh, self.placement = make_mesh(self._size, self._radius, devices, self._strategy)
+        self.mesh, self.placement = make_mesh(
+            self._size, self._radius, devices, self._strategy, force_dim=self._force_dim
+        )
         self.stats.time_placement = time.perf_counter() - t0
         dim = self.placement.dim()
         # uneven sizes: pad each axis's shard to ceil(size/dim) and mask (the

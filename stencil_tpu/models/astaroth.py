@@ -42,6 +42,8 @@ class AstarothSim:
         strategy: PlacementStrategy = PlacementStrategy.NodeAware,
         devices=None,
         dtype=jnp.float32,
+        kernel_impl: str = "jnp",  # "jnp" | "pallas" (plane streaming)
+        interpret: bool = False,
     ):
         self.dd = DistributedDomain(x, y, z)
         self.dd.set_radius(Radius.constant(3))  # astaroth_sim.cu:184
@@ -53,6 +55,8 @@ class AstarothSim:
             self.dd.add_data(f"d{i}", dtype=dtype) for i in range(num_quantities)
         ]
         self.overlap = overlap
+        self.kernel_impl = kernel_impl
+        self.interpret = interpret
         self._step = None
 
     def realize(self) -> None:
@@ -60,7 +64,64 @@ class AstarothSim:
         w = 2 * math.pi / self.period
         for h in self.handles:
             self.dd.init_by_coords(h, lambda x, y, z: jnp.sin(w * (x + y + z)))
-        self._step = self.dd.make_step(self._kernel, overlap=self.overlap)
+        if self.kernel_impl == "pallas":
+            if self.dd.halo_multiplier() != 1:
+                raise ValueError("pallas path requires halo multiplier 1")
+            if not self.overlap:
+                raise ValueError(
+                    "overlap=False has no meaning for the fused pallas step; "
+                    "use kernel_impl='jnp' for overlap comparisons"
+                )
+            self._step = self._make_pallas_step()
+        else:
+            self._step = self.dd.make_step(self._kernel, overlap=self.overlap)
+
+    def _make_pallas_step(self):
+        """Plane-streaming mean-of-6 kernel (ops/plane_stencil) fused with the
+        exchange — one HBM read + one write per plane per iteration."""
+        from functools import partial
+
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from stencil_tpu.ops.exchange import halo_exchange_shard
+        from stencil_tpu.ops.plane_stencil import mean6_plane_step
+        from stencil_tpu.parallel.mesh import MESH_AXES
+
+        dd = self.dd
+        shell = dd._shell_radius
+        lo, hi = shell.lo(), shell.hi()
+        mesh_shape = tuple(dd.mesh.shape[a] for a in MESH_AXES)
+        valid_last = dd._valid_last
+        interpret = self.interpret
+        names = [h.name for h in self.handles]
+
+        def per_shard(steps, *blocks):
+            def body(_, bs):
+                out = []
+                for b in bs:
+                    b = halo_exchange_shard(b, shell, mesh_shape, valid_last=valid_last)
+                    out.append(mean6_plane_step(b, lo, hi, interpret=interpret))
+                return tuple(out)
+
+            return lax.fori_loop(0, steps, body, tuple(blocks))
+
+        spec = P(*MESH_AXES)
+
+        @partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def step(curr, steps: int = 1):
+            fn = jax.shard_map(
+                partial(per_shard, steps),
+                mesh=dd.mesh,
+                in_specs=tuple(spec for _ in names),
+                out_specs=tuple(spec for _ in names),
+                check_vma=False,
+            )
+            outs = fn(*[curr[k] for k in names])
+            return dict(zip(names, outs))
+
+        return step
 
     def _kernel(self, views, info):
         out = {}

@@ -1,0 +1,78 @@
+"""Generic plane-streaming 6-neighbor-mean kernel (arbitrary shell widths).
+
+Same ring-buffer structure as ops/jacobi_pallas.py (one HBM read + one write
+per x-plane) generalized to a shell of any per-axis width: compute planes
+``[lo.x, X - hi.x)`` with the in-plane window ``[lo.y, Y - hi.y) x
+[lo.z, Z - hi.z)``; every other cell (the shell) passes through unchanged.
+Used by the Astaroth proxy (radius-3 shell, distance-1 reads —
+astaroth_sim.cu:65-83 via a 3-wide halo it exchanges but does not read, like
+the real Astaroth's communication volume model).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.core.dim3 import Dim3
+
+
+def mean6_plane_step(
+    block: jax.Array, lo: Dim3, hi: Dim3, interpret: bool = False
+) -> jax.Array:
+    """One mean-of-6-face-neighbors iteration over a shell-carrying block."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    X, Y, Z = block.shape
+    # every side needs >= 1 shell cell: the distance-1 reads and the
+    # plane-replay at the grid edges assume neighbors exist in-allocation
+    assert lo.all_ge(1) and hi.all_ge(1), (lo, hi)
+    y0, y1 = lo.y, Y - hi.y
+    z0, z1 = lo.z, Z - hi.z
+
+    def kernel(in_ref, out_ref, ring):
+        i = pl.program_id(0)
+        cur = in_ref[0]
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[0] = cur  # first plane passes through
+
+        @pl.when(jnp.logical_and(i >= 1, i <= X))
+        def _():
+            cent = ring[(i + 1) % 2]  # plane i-1
+
+            in_window = jnp.logical_and(i - 1 >= lo.x, i - 1 <= X - hi.x - 1)
+
+            @pl.when(in_window)
+            def _():
+                prev = ring[i % 2]  # plane i-2
+                mean = (
+                    prev[y0:y1, z0:z1]
+                    + cur[y0:y1, z0:z1]
+                    + cent[y0 - 1 : y1 - 1, z0:z1]
+                    + cent[y0 + 1 : y1 + 1, z0:z1]
+                    + cent[y0:y1, z0 - 1 : z1 - 1]
+                    + cent[y0:y1, z0 + 1 : z1 + 1]
+                ) / 6.0
+                out_ref[0] = cent  # keep the y/z shell
+                out_ref[0, y0:y1, z0:z1] = mean.astype(cur.dtype)
+
+            @pl.when(jnp.logical_not(in_window))
+            def _():
+                out_ref[0] = cent  # shell plane passes through
+
+        @pl.when(i <= X - 1)
+        def _():
+            ring[i % 2] = cur
+
+    return pl.pallas_call(
+        kernel,
+        grid=(X + 1,),
+        in_specs=[pl.BlockSpec((1, Y, Z), lambda i: (jnp.minimum(i, X - 1), 0, 0))],
+        out_specs=pl.BlockSpec((1, Y, Z), lambda i: (jnp.clip(i - 1, 0, X - 1), 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
+        scratch_shapes=[pltpu.VMEM((2, Y, Z), block.dtype)],
+        interpret=interpret,
+    )(block)

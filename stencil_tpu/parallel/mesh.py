@@ -17,7 +17,7 @@ from jax.sharding import Mesh
 
 from stencil_tpu.core.dim3 import Dim3
 from stencil_tpu.core.radius import Radius
-from stencil_tpu.parallel.partition import NodePartition
+from stencil_tpu.parallel.partition import ManualPartition, NodePartition
 from stencil_tpu.parallel.placement import Placement, make_placement
 from stencil_tpu.parallel.topology import num_processes
 from stencil_tpu.utils.config import PlacementStrategy
@@ -39,11 +39,22 @@ def make_mesh(
     radius: Radius,
     devices: Optional[Sequence] = None,
     strategy: PlacementStrategy = PlacementStrategy.NodeAware,
+    force_dim=None,
 ):
-    """Partition ``size`` over ``devices`` and build the (Mesh, Placement)."""
+    """Partition ``size`` over ``devices`` and build the (Mesh, Placement).
+    ``force_dim`` bypasses the splitters with a user-specified grid (manual
+    partition, the reference's future-work item)."""
     if devices is None:
         devices = jax.devices()
-    part = choose_partition(size, radius, devices)
+    if force_dim is not None:
+        part = ManualPartition(Dim3.of(size), force_dim)
+        if part.dim().flatten() != len(devices):
+            raise ValueError(
+                f"manual partition {part.dim()} needs {part.dim().flatten()} "
+                f"devices, have {len(devices)}"
+            )
+    else:
+        part = choose_partition(size, radius, devices)
     placement = make_placement(strategy, part, devices, radius)
     mesh = Mesh(placement.device_grid(), MESH_AXES)
     return mesh, placement

@@ -130,6 +130,29 @@ class RankPartition(_PartitionBase):
         return self._dim
 
 
+class ManualPartition(_PartitionBase):
+    """User-specified process grid (the reference's future-work "manual
+    partition", README.md:157-176): the mesh shape is taken verbatim instead
+    of derived by the splitters."""
+
+    def __init__(self, size, dim):
+        size = Dim3.of(size)
+        self._dim = Dim3.of(dim)
+        assert self._dim.all_ge(1)
+        self._size = Dim3(
+            _div_ceil(size.x, self._dim.x),
+            _div_ceil(size.y, self._dim.y),
+            _div_ceil(size.z, self._dim.z),
+        )
+        self._rem = size % self._dim
+
+    def dim(self) -> Dim3:
+        return self._dim
+
+    def idx(self, i: int) -> Dim3:
+        return self.dimensionize(i)
+
+
 class NodePartition(_PartitionBase):
     """Two-level min-interface splitter (partition.hpp:210-264).
 

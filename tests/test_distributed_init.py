@@ -1,0 +1,27 @@
+"""Tier-1: single-process behavior of the multi-host coordination API."""
+
+import numpy as np
+
+from stencil_tpu.parallel import distributed
+
+
+def test_initialize_single_process_noop():
+    distributed.initialize()  # must not raise without a cluster env
+    assert distributed.process_count() >= 1
+    assert distributed.process_index() == 0
+
+
+def test_barrier_noop():
+    distributed.barrier()
+
+
+def test_broadcast_identity():
+    tree = {"a": np.arange(3), "b": 7}
+    out = distributed.broadcast_from_host0(tree)
+    np.testing.assert_array_equal(out["a"], tree["a"])
+    assert out["b"] == 7
+
+
+def test_allgather_single():
+    out = distributed.allgather_hosts(np.array([1.0, 2.0]))
+    assert out.shape == (1, 2)

@@ -1,0 +1,40 @@
+"""Tier-2: manual partition — user-forced process grids."""
+
+import numpy as np
+import pytest
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.geometry import ripple_value
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.parallel.partition import ManualPartition
+
+
+def test_manual_partition_math():
+    p = ManualPartition(Dim3(10, 10, 10), Dim3(8, 1, 1))
+    assert p.dim() == Dim3(8, 1, 1)
+    assert p.subdomain_size(Dim3(0, 0, 0)) == Dim3(2, 10, 10)
+    # uneven remainder: trailing shards shrink (partition.hpp:83-98)
+    assert p.subdomain_size(Dim3(7, 0, 0)).x == 1
+
+
+@pytest.mark.parametrize("grid", [(8, 1, 1), (1, 8, 1), (2, 2, 2), (4, 2, 1)])
+def test_forced_grid_exchange(grid):
+    dd = DistributedDomain(16, 16, 16)
+    dd.set_partition(*grid)
+    dd.set_radius(1)
+    h = dd.add_data("q")
+    dd.realize()
+    assert tuple(dd.placement.dim()) == grid
+    dd.init_by_coords(h, lambda x, y, z: x * 1.0 + y * 100.0 + z * 10000.0)
+    before = dd.quantity_to_host(h)
+    dd.exchange()
+    np.testing.assert_array_equal(dd.quantity_to_host(h), before)
+
+
+def test_wrong_device_count_raises():
+    dd = DistributedDomain(16, 16, 16)
+    dd.set_partition(3, 1, 1)  # 3 != 8 devices
+    dd.set_radius(1)
+    dd.add_data("q")
+    with pytest.raises(ValueError):
+        dd.realize()

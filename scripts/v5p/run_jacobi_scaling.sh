@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# jacobi3d weak-scaling efficiency on a TPU pod — the north-star measurement
+# (BASELINE.md: >=90% parallel efficiency on v5p-256).  Per-chip throughput
+# at N chips divided by the single-chip throughput is the efficiency.
+#
+# Run on every worker of the slice; the driver weak-scales the global domain
+# by numChips^(1/3) automatically (models/jacobi.py weak_scaled_size).
+set -euo pipefail
+BASE="${1:-512}"
+ITERS="${2:-30}"
+
+cd "$(dirname "$0")/../.."
+python -m stencil_tpu.bin.jacobi3d "$BASE" "$BASE" "$BASE" --iters "$ITERS"
+python -m stencil_tpu.bin.jacobi3d "$BASE" "$BASE" "$BASE" --iters "$ITERS" --no-overlap

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Weak-scaling sweep on a TPU pod — the analog of the reference's Summit
+# scripts (scripts/summit/run_16node_weak_spec.sh: 750^3 per unit, 30 iters,
+# method sweep).  Run the same command on every worker of the pod slice
+# (e.g. via `gcloud compute tpus tpu-vm ssh --worker=all`); JAX discovers the
+# pod topology and spans all chips.
+#
+# Usage: ./run_weak.sh [BASE=512] [ITERS=30]
+set -euo pipefail
+BASE="${1:-512}"
+ITERS="${2:-30}"
+
+cd "$(dirname "$0")/../.."
+
+# the reference sweeps its five transports; on TPU the production collective
+# path is one config, with the all-gather debug method as the comparison
+python -m stencil_tpu.bin.weak "$BASE" "$BASE" "$BASE" "$ITERS"
+python -m stencil_tpu.bin.weak "$BASE" "$BASE" "$BASE" "$ITERS" --naive

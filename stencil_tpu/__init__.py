@@ -16,7 +16,7 @@ from stencil_tpu.core.dim3 import Dim3, Rect3
 from stencil_tpu.core.direction_map import DirectionMap, DIRECTIONS_26
 from stencil_tpu.core.radius import Radius
 from stencil_tpu.core.geometry import LocalSpec
-from stencil_tpu.utils.config import MethodFlags
+from stencil_tpu.utils.config import MethodFlags, PlacementStrategy
 
 __version__ = "0.1.0"
 
@@ -28,14 +28,26 @@ __all__ = [
     "Radius",
     "LocalSpec",
     "MethodFlags",
+    "PlacementStrategy",
     "DistributedDomain",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "write_paraview",
 ]
+
+_LAZY = {
+    # these pull in jax; keep the geometry core importable without it
+    "DistributedDomain": ("stencil_tpu.domain", "DistributedDomain"),
+    "save_checkpoint": ("stencil_tpu.io.checkpoint", "save_checkpoint"),
+    "restore_checkpoint": ("stencil_tpu.io.checkpoint", "restore_checkpoint"),
+    "write_paraview": ("stencil_tpu.io.paraview", "write_paraview"),
+}
 
 
 def __getattr__(name):
-    # DistributedDomain pulls in jax; keep the geometry core importable without it.
-    if name == "DistributedDomain":
-        from stencil_tpu.domain import DistributedDomain
+    if name in _LAZY:
+        import importlib
 
-        return DistributedDomain
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

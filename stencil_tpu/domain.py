@@ -416,9 +416,20 @@ class DistributedDomain:
             self.stats.time_swap += time.perf_counter() - t0
 
     def block_until_ready(self) -> None:
-        """Wait for all in-flight device work on the current buffers."""
+        """Wait for all in-flight device work on the current buffers.
+
+        On standard backends (tpu/gpu/cpu) ``jax.Array.block_until_ready``
+        is sufficient and nothing else runs — timings stay clean.  Tunneled
+        dev backends (e.g. ``axon``) report readiness before execution
+        finishes; there a 1-element readback of an *addressable* shard forces
+        true completion (per-process addressable, so multi-host safe)."""
         for a in self._curr.values():
             a.block_until_ready()
+        if jax.default_backend() in ("tpu", "gpu", "cpu"):
+            return
+        for a in self._curr.values():
+            shard = a.addressable_shards[0].data
+            jax.device_get(shard[(slice(0, 1),) * shard.ndim])
 
     def get_curr(self, h: DataHandle) -> jax.Array:
         return self._curr[h.name]

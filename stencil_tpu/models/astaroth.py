@@ -144,4 +144,4 @@ class AstarothSim:
         return self.dd.quantity_to_host(self.handles[i])
 
     def block_until_ready(self) -> None:
-        self.dd.get_curr(self.handles[0]).block_until_ready()
+        self.dd.block_until_ready()

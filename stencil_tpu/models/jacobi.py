@@ -163,7 +163,7 @@ class Jacobi3D:
         return self.dd.quantity_to_host(self.h)
 
     def block_until_ready(self) -> None:
-        self.dd.get_curr(self.h).block_until_ready()
+        self.dd.block_until_ready()
 
 
 def weak_scaled_size(base: int, num_subdomains: int) -> int:

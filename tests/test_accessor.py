@@ -1,0 +1,37 @@
+"""Tier-1: global-coordinate Accessor (reference test_cuda_accessor.cu)."""
+
+import numpy as np
+
+from stencil_tpu.core.accessor import Accessor
+from stencil_tpu.core.dim3 import Dim3, Rect3
+
+
+def _make():
+    # interior 4x5x6 at global origin (10, 20, 30), shell width 2
+    raw = np.arange(8 * 9 * 10, dtype=np.float32).reshape(8, 9, 10)
+    return Accessor(raw, origin=Dim3(10, 20, 30), lo_off=Dim3(2, 2, 2)), raw
+
+
+def test_scalar_read_origin_offset():
+    acc, raw = _make()
+    # the interior origin lives at raw index (2, 2, 2) (accessor.hpp:27-40)
+    assert acc[Dim3(10, 20, 30)] == raw[2, 2, 2]
+    assert acc[(11, 22, 33)] == raw[3, 4, 5]
+    # halo cells are addressable below the origin
+    assert acc[(9, 19, 29)] == raw[1, 1, 1]
+
+
+def test_region_slice():
+    acc, raw = _make()
+    r = Rect3(Dim3(10, 20, 30), Dim3(12, 23, 34))
+    np.testing.assert_array_equal(acc.region(r), raw[2:4, 2:5, 2:6])
+
+
+def test_shifted_is_stencil_term():
+    acc, raw = _make()
+    region = Rect3(Dim3(10, 20, 30), Dim3(14, 25, 36))  # whole interior
+    center = acc.shifted(region, (0, 0, 0))
+    plus_x = acc.shifted(region, (1, 0, 0))
+    np.testing.assert_array_equal(plus_x[:-1], center[1:])
+    minus_z = acc.shifted(region, (0, 0, -1))
+    np.testing.assert_array_equal(minus_z[:, :, 1:], center[:, :, :-1])

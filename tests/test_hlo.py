@@ -1,0 +1,36 @@
+"""Tier-2: compiled-HLO structure checks.
+
+The 3-axis-sweep design promises <= 6 collectives per step for 26-neighbor
+halos (SURVEY.md §7 "26-neighbor exchange": naive = 26 ppermutes).  Pin that
+on the compiled step so a regression back to per-direction messages is
+caught at compile level.  (True async overlap — permute-start/done straddling
+interior compute — only materializes on the TPU backend; the CPU backend
+lowers collective-permute synchronously, so it is asserted on hardware runs,
+not here.)
+"""
+
+import re
+
+from stencil_tpu.models.astaroth import AstarothSim
+from stencil_tpu.models.jacobi import Jacobi3D
+
+
+def _permute_count(model) -> int:
+    step = model._step
+    txt = step.lower(model.dd._curr, 1).compile().as_text()
+    return len(re.findall(r"collective-permute", txt))
+
+
+def test_jacobi_step_has_at_most_6_permutes():
+    m = Jacobi3D(24, 24, 24)
+    m.realize()
+    n = _permute_count(m)
+    assert 1 <= n <= 6, n
+
+
+def test_astaroth_26dir_step_still_6_permutes():
+    """Radius-3 face+edge+corner halos must NOT explode into 26 messages."""
+    m = AstarothSim(28, 28, 28)
+    m.realize()
+    n = _permute_count(m)
+    assert 1 <= n <= 6, n

@@ -47,7 +47,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from stencil_tpu.core.dim3 import Dim3, Rect3
 from stencil_tpu.core.geometry import LocalSpec
 from stencil_tpu.core.radius import Radius
-from stencil_tpu.ops.exchange import halo_exchange_shard, make_exchange_fn
+from stencil_tpu.ops.exchange import (
+    halo_exchange_multi,
+    halo_exchange_shard,
+    make_exchange_fn,
+)
 from stencil_tpu.parallel.mesh import MESH_AXES, make_mesh
 from stencil_tpu.parallel.placement import Placement
 from stencil_tpu.utils.config import MethodFlags, PlacementStrategy
@@ -561,12 +565,19 @@ class DistributedDomain:
                 with jax.named_scope("interior_compute"):
                     int_region = rect_to_slices(interior_rect)
                     int_vals = region_update(blocks, int_region, origin)
-            exch = {
-                k: halo_exchange_shard(
-                    b, shell, mesh_shape, valid_last=self._valid_last
+            # joint multi-quantity exchange: all fields fuse into one message
+            # per direction (reference packer.cuh:52-69), ≤6 permutes total
+            exch = dict(
+                zip(
+                    names,
+                    halo_exchange_multi(
+                        [blocks[k] for k in names],
+                        shell,
+                        mesh_shape,
+                        valid_last=self._valid_last,
+                    ),
                 )
-                for k, b in blocks.items()
-            }
+            )
             cur = exch
             for j, rect in enumerate(sub_regions):
                 region = rect_to_slices(rect)

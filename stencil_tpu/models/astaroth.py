@@ -85,7 +85,7 @@ class AstarothSim:
         from jax import lax
         from jax.sharding import PartitionSpec as P
 
-        from stencil_tpu.ops.exchange import halo_exchange_shard
+        from stencil_tpu.ops.exchange import halo_exchange_multi
         from stencil_tpu.ops.plane_stencil import mean6_plane_step
         from stencil_tpu.parallel.mesh import MESH_AXES
 
@@ -99,11 +99,11 @@ class AstarothSim:
 
         def per_shard(steps, *blocks):
             def body(_, bs):
-                out = []
-                for b in bs:
-                    b = halo_exchange_shard(b, shell, mesh_shape, valid_last=valid_last)
-                    out.append(mean6_plane_step(b, lo, hi, interpret=interpret))
-                return tuple(out)
+                # joint exchange: ≤6 permutes for any field count
+                bs = halo_exchange_multi(bs, shell, mesh_shape, valid_last=valid_last)
+                return tuple(
+                    mean6_plane_step(b, lo, hi, interpret=interpret) for b in bs
+                )
 
             return lax.fori_loop(0, steps, body, tuple(blocks))
 

@@ -27,7 +27,7 @@ same-GPU ``PeerAccessSender`` kernels (tx_cuda.cuh:39-104).
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -51,18 +51,84 @@ def _shift_from_high(x, axis_name: str, n: int):
         return lax.ppermute(x, axis_name, [(k, (k - 1) % n) for k in range(n)])
 
 
-def halo_exchange_shard(
-    block: jax.Array,
+def _fused_shift(slabs: List[jax.Array], shift_fn, name: str, n_dev: int) -> List[jax.Array]:
+    """ppermute several quantities' slabs as ONE fused message.
+
+    The reference packs all quantities of one neighbor into a single aligned
+    buffer so message count is independent of field count (packer.cuh:52-69,
+    146-160).  Here: same-dtype slabs stack along a flattened leading axis
+    (one collective-permute carries the stack); mixed dtypes additionally
+    fuse byte-wise via ``bitcast_convert_type`` — one buffer per direction,
+    exactly the reference's byte-packed layout.  Returns received slabs in
+    the original order/shapes.
+    """
+    if len(slabs) == 1:
+        return [shift_fn(slabs[0], name, n_dev)]
+    # flatten leading (quantity/batch) dims so same-dtype slabs concatenate
+    flat = [s.reshape((-1,) + s.shape[-3:]) for s in slabs]
+    groups: Dict[object, List[int]] = {}
+    for i, s in enumerate(flat):
+        groups.setdefault(s.dtype, []).append(i)
+    bufs = [
+        (dt, idxs, jnp.concatenate([flat[i] for i in idxs], axis=0))
+        for dt, idxs in groups.items()
+    ]
+    if len(bufs) == 1:
+        dt, idxs, buf = bufs[0]
+        bufs = [(dt, idxs, shift_fn(buf, name, n_dev))]
+    else:
+        # mixed dtypes: one byte buffer per direction (packer.cuh:52-69)
+        def to_bytes(v):
+            if v.dtype == jnp.bool_:
+                return v.reshape(-1).astype(jnp.uint8)  # lossless 0/1
+            if v.dtype.itemsize > 1:
+                return lax.bitcast_convert_type(v.reshape(-1), jnp.uint8).reshape(-1)
+            return lax.bitcast_convert_type(v.reshape(-1), jnp.uint8)
+
+        def from_bytes(p, dt):
+            if dt == jnp.bool_:
+                return p.astype(jnp.bool_)
+            if jnp.dtype(dt).itemsize > 1:
+                return lax.bitcast_convert_type(
+                    p.reshape(-1, jnp.dtype(dt).itemsize), dt
+                )
+            return lax.bitcast_convert_type(p, dt)
+
+        fused = jnp.concatenate([to_bytes(buf) for _, _, buf in bufs])
+        recv_bytes = shift_fn(fused, name, n_dev)
+        recv_parts, off = [], 0
+        for dt, _, buf in bufs:
+            nbytes = buf.size * buf.dtype.itemsize
+            p = recv_bytes[off : off + nbytes]
+            off += nbytes
+            recv_parts.append(from_bytes(p, dt).reshape(buf.shape))
+        bufs = [(dt, idxs, rp) for (dt, idxs, _), rp in zip(bufs, recv_parts)]
+    out: List[Optional[jax.Array]] = [None] * len(slabs)
+    for _, idxs, rbuf in bufs:
+        off = 0
+        for i in idxs:
+            k = flat[i].shape[0]
+            out[i] = rbuf[off : off + k].reshape(slabs[i].shape)
+            off += k
+    return out  # type: ignore[return-value]
+
+
+def halo_exchange_multi(
+    blocks: Sequence[jax.Array],
     radius: Radius,
     mesh_shape: Tuple[int, int, int],
     axis_names: Sequence[str] = MESH_AXES,
     valid_last: Optional[Tuple[Optional[int], Optional[int], Optional[int]]] = None,
-) -> jax.Array:
-    """Fill the halo shell of one shell-carrying shard.  Must run inside
-    ``shard_map`` over a mesh with ``axis_names``.
+) -> List[jax.Array]:
+    """Fill the halo shells of several shell-carrying shards JOINTLY —
+    ≤ 2 ppermutes per axis sweep (≤ 6 total) no matter how many quantities,
+    the reference's fused multi-quantity buffers (packer.cuh:52-69).  Must run
+    inside ``shard_map`` over a mesh with ``axis_names``.
 
-    ``block`` has extent ``interior + r_lo + r_hi`` per axis; the interior
-    occupies ``[r_lo, r_lo + n)``.
+    Each block's spatial extent is its LAST three dims (leading batch/
+    quantity dims ride along inside the fused message); every block must
+    share the same spatial shape ``interior + r_lo + r_hi`` per axis, with
+    the interior at ``[r_lo, r_lo + n)``.
 
     ``valid_last`` supports uneven global sizes via pad-and-mask (the
     reference's +-1-cell remainders, partition.hpp:83-114): entry ``a`` is the
@@ -72,6 +138,15 @@ def halo_exchange_shard(
     valid cells — slab positions become per-shard ``lax.dynamic_slice``
     offsets derived from ``axis_index``; the collective itself is unchanged.
     """
+    blocks = list(blocks)
+    if not blocks:
+        return blocks
+    spatial = blocks[0].shape[-3:]
+    if not all(b.shape[-3:] == spatial for b in blocks):
+        raise ValueError(
+            "all quantities must share one spatial (last-3-dims) shape; got "
+            f"{[b.shape for b in blocks]}"
+        )
     for axis in range(3):
         r_lo = radius.axis(axis, -1)  # my low-side halo width
         r_hi = radius.axis(axis, +1)  # my high-side halo width
@@ -79,57 +154,70 @@ def halo_exchange_shard(
             continue
         name = axis_names[axis]
         n_dev = mesh_shape[axis]
-        size = block.shape[axis]  # raw extent on this axis
+        size = spatial[axis]  # raw extent on this axis
         n_pad = size - r_lo - r_hi  # per-shard (padded) interior width
         v_last = valid_last[axis] if valid_last is not None else None
         uneven = v_last is not None and v_last != n_pad
 
-        def axslice(lo, hi):
-            idx = [slice(None)] * block.ndim
-            idx[axis] = slice(lo, hi)
+        def axslice(b, lo, hi):
+            idx = [slice(None)] * b.ndim
+            idx[b.ndim - 3 + axis] = slice(lo, hi)
             return tuple(idx)
 
-        def dyn_starts(start):
-            s = [jnp.int32(0)] * block.ndim
-            s[axis] = start
+        def dyn_starts(b, start):
+            s = [jnp.int32(0)] * b.ndim
+            s[b.ndim - 3 + axis] = start
             return tuple(s)
 
-        def slab_sizes(w):
-            s = list(block.shape)
-            s[axis] = w
+        def slab_sizes(b, w):
+            s = list(b.shape)
+            s[b.ndim - 3 + axis] = w
             return tuple(s)
 
         if uneven:
             idx = lax.axis_index(name)
             n_valid = jnp.where(idx == n_dev - 1, v_last, n_pad).astype(jnp.int32)
-        updates = []
+        lo_recv = hi_recv = None
         if r_lo > 0:
             # my low halo [0, r_lo) <- -axis neighbor's top slab of VALID
             # interior, width r_lo (message traveling +axis has extent
-            # radius(-axis))
-            if uneven:
-                # top r_lo rows of my valid interior: [n_valid, n_valid+r_lo)
-                # in allocation coords (interior starts at r_lo)
-                slab = lax.dynamic_slice(block, dyn_starts(n_valid), slab_sizes(r_lo))
-            else:
-                slab = block[axslice(n_pad, r_lo + n_pad)]
-            recv = _shift_from_low(slab, name, n_dev)
-            updates.append((axslice(0, r_lo), None, recv))
+            # radius(-axis)).  Uneven: top r_lo rows of my valid interior,
+            # [n_valid, n_valid + r_lo) in allocation coords.
+            slabs = [
+                lax.dynamic_slice(b, dyn_starts(b, n_valid), slab_sizes(b, r_lo))
+                if uneven
+                else b[axslice(b, n_pad, r_lo + n_pad)]
+                for b in blocks
+            ]
+            lo_recv = _fused_shift(slabs, _shift_from_low, name, n_dev)
         if r_hi > 0:
             # my high halo <- +axis neighbor's interior bottom slab, width
             # r_hi, written right after MY valid cells
-            slab = block[axslice(r_lo, r_lo + r_hi)]
-            recv = _shift_from_high(slab, name, n_dev)
-            if uneven:
-                updates.append((None, dyn_starts(r_lo + n_valid), recv))
-            else:
-                updates.append((axslice(r_lo + n_pad, size), None, recv))
-        for sl, starts, val in updates:
-            if starts is not None:
-                block = lax.dynamic_update_slice(block, val, starts)
-            else:
-                block = block.at[sl].set(val)
-    return block
+            slabs = [b[axslice(b, r_lo, r_lo + r_hi)] for b in blocks]
+            hi_recv = _fused_shift(slabs, _shift_from_high, name, n_dev)
+        for j, b in enumerate(blocks):
+            if lo_recv is not None:
+                b = b.at[axslice(b, 0, r_lo)].set(lo_recv[j])
+            if hi_recv is not None:
+                if uneven:
+                    b = lax.dynamic_update_slice(
+                        b, hi_recv[j], dyn_starts(b, r_lo + n_valid)
+                    )
+                else:
+                    b = b.at[axslice(b, r_lo + n_pad, size)].set(hi_recv[j])
+            blocks[j] = b
+    return blocks
+
+
+def halo_exchange_shard(
+    block: jax.Array,
+    radius: Radius,
+    mesh_shape: Tuple[int, int, int],
+    axis_names: Sequence[str] = MESH_AXES,
+    valid_last: Optional[Tuple[Optional[int], Optional[int], Optional[int]]] = None,
+) -> jax.Array:
+    """Single-quantity convenience wrapper over ``halo_exchange_multi``."""
+    return halo_exchange_multi([block], radius, mesh_shape, axis_names, valid_last)[0]
 
 
 def make_exchange_fn_allgather(mesh: Mesh, radius: Radius, spec, dim):
@@ -191,22 +279,11 @@ def make_exchange_fn(
     @partial(jax.jit, donate_argnums=0)
     def exchange(arrays):
         def per_shard(*blocks):
-            out = []
-            for b in blocks:
-                # leading batch dims ride along: halo axes are the last three
-                if ndim_extra:
-                    bb = b.reshape((-1,) + b.shape[-3:])
-                    bb = jax.vmap(
-                        lambda v: halo_exchange_shard(
-                            v, radius, mesh_shape, valid_last=valid_last
-                        )
-                    )(bb)
-                    out.append(bb.reshape(b.shape))
-                else:
-                    out.append(
-                        halo_exchange_shard(b, radius, mesh_shape, valid_last=valid_last)
-                    )
-            return tuple(out)
+            # ALL quantities (and any leading batch dims) ride one fused
+            # message per direction — ≤6 permutes total (packer.cuh:52-69)
+            return tuple(
+                halo_exchange_multi(blocks, radius, mesh_shape, valid_last=valid_last)
+            )
 
         leaves, treedef = jax.tree.flatten(arrays)
         shard_fn = jax.shard_map(

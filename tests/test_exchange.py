@@ -184,3 +184,30 @@ def test_swap():
     dd.swap()
     np.testing.assert_array_equal(dd.quantity_to_host(h, "next"), a)
     assert dd.quantity_to_host(h, "curr").sum() == 0
+
+
+def test_exchange_int8_and_bool_quantities():
+    """1-byte dtypes (int8, bool) must survive the byte-fused message path."""
+    import jax.numpy as jnp
+
+    dd = DistributedDomain(16, 16, 16)
+    dd.set_radius(Radius.constant(1))
+    hf = dd.add_data("f", jnp.float32)
+    hi = dd.add_data("i8", jnp.int8)
+    hb = dd.add_data("m", jnp.bool_)
+    dd.realize()
+    dd.init_by_coords(hf, lambda x, y, z: (x + y + z).astype(jnp.float32))
+    dd.init_by_coords(hi, lambda x, y, z: ((x + y + z) % 100).astype(jnp.int8))
+    dd.init_by_coords(hb, lambda x, y, z: (x + y + z) % 2 == 0)
+    dd.exchange()
+    spec = dd.local_spec()
+    raw = dd.raw_to_host(hi)
+    rawb = dd.raw_to_host(hb)
+    rawsz, n, lo = spec.raw_size(), spec.sz, dd.radius().lo()
+    dim = dd.placement.dim()
+    for ix in range(dim.x):
+        blk = raw[ix * rawsz.x : (ix + 1) * rawsz.x, : rawsz.y, : rawsz.z]
+        blkb = rawb[ix * rawsz.x : (ix + 1) * rawsz.x, : rawsz.y, : rawsz.z]
+        gx = (ix * n.x - lo.x) % 16  # -x halo cell's global x
+        assert blk[0, 1, 1] == (gx + 0 + 0) % 100
+        assert blkb[0, 1, 1] == ((gx + 0 + 0) % 2 == 0)

@@ -34,3 +34,54 @@ def test_astaroth_26dir_step_still_6_permutes():
     m.realize()
     n = _permute_count(m)
     assert 1 <= n <= 6, n
+
+
+def test_astaroth_4_quantities_still_6_permutes():
+    """Message count must be independent of field count: all quantities fuse
+    into ONE buffer per direction (reference packer.cuh:52-69).  Before the
+    fused multi-quantity exchange this compiled to 6*N permutes."""
+    m = AstarothSim(28, 28, 28, num_quantities=4)
+    m.realize()
+    n = _permute_count(m)
+    assert 1 <= n <= 6, n
+
+
+def test_mixed_dtype_quantities_still_6_permutes():
+    """Mixed-dtype fields byte-fuse into the same per-direction buffer, like
+    the reference's elemSize-aligned packed layout (packer.cuh:146-160)."""
+    import jax.numpy as jnp
+
+    from stencil_tpu.domain import DistributedDomain
+
+    dd = DistributedDomain(24, 24, 24)
+    dd.set_radius(1)
+    hs = [
+        dd.add_data("f32", jnp.float32),
+        dd.add_data("bf16", jnp.bfloat16),
+        dd.add_data("i32", jnp.int32),
+    ]
+    dd.realize()
+
+    def kernel(views, info):
+        return {h.name: views[h.name].center() for h in hs}
+
+    step = dd.make_step(kernel)
+    txt = step.lower(dd._curr, 1).compile().as_text()
+    n = len(re.findall(r"collective-permute", txt))
+    assert 1 <= n <= 6, n
+
+
+def test_exchange_fn_4_quantities_6_permutes():
+    """The standalone exchange (make_exchange_fn) fuses too."""
+    import jax.numpy as jnp
+
+    from stencil_tpu.domain import DistributedDomain
+
+    dd = DistributedDomain(24, 24, 24)
+    dd.set_radius(2)
+    for i in range(4):
+        dd.add_data(f"q{i}", jnp.float32)
+    dd.realize()
+    txt = dd._exchange_fn.lower(dd._curr).compile().as_text()
+    n = len(re.findall(r"collective-permute", txt))
+    assert 1 <= n <= 6, n

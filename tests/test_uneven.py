@@ -102,3 +102,30 @@ def test_too_small_remainder_raises():
     dd.add_data("q")
     with pytest.raises(ValueError):
         dd.realize()
+
+
+def test_uneven_multi_quantity_mixed_dtype_exchange():
+    """The fused multi-quantity exchange (one byte-fused message per
+    direction) must keep the per-shard dynamic slab offsets of the pad-and-
+    mask path: uneven axis + mixed dtypes together."""
+    dd = DistributedDomain(15, 16, 16)  # x padded: 15 over 2 -> n=8, last=7
+    dd.set_radius(Radius.constant(1))
+    h1 = dd.add_data("a", np.float32)
+    h2 = dd.add_data("b", np.float64)
+    dd.realize()
+    dd.init_by_coords(h1, lambda x, y, z: (x * 10000 + y * 100 + z).astype(np.float32))
+    dd.init_by_coords(h2, lambda x, y, z: (x * 10000 + y * 100 + z).astype(np.float64))
+    dd.exchange()
+    spec = dd.local_spec()
+    rawsz, n, lo = spec.raw_size(), spec.sz, dd.radius().lo()
+    dim = dd.placement.dim()
+    for h in (h1, h2):
+        raw = dd.raw_to_host(h)
+        # shard (0,0,0): -x halo must hold the last VALID x (14), not padding
+        blk = raw[: rawsz.x, : rawsz.y, : rawsz.z]
+        assert blk[0, 1, 1] == 14 * 10000.0
+        # last x-shard's +x halo must wrap to global x = 0
+        ix = dim.x - 1
+        blk = raw[ix * rawsz.x : (ix + 1) * rawsz.x, : rawsz.y, : rawsz.z]
+        v = dd.shard_valid(Dim3(ix, 0, 0))
+        assert blk[lo.x + v.x, 1, 1] == 0 * 10000.0 + 0 * 100.0 + 0

@@ -1,0 +1,240 @@
+"""Probe 3: wrap-in-kernel jacobi (no shell, no exchange) vs the current
+full model step.  Run on chip."""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 512
+HOT, COLD = 1.0, 0.0
+
+
+def rt_s() -> float:
+    x = jnp.zeros((8,))
+    float(jnp.sum(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(jnp.sum(x))
+    return (time.perf_counter() - t0) / 5
+
+
+def timed(fn, a, rt, steps=100):
+    @partial(jax.jit, donate_argnums=0, static_argnums=1)
+    def loop(a, s):
+        return lax.fori_loop(0, s, lambda _, x: fn(x), a)
+
+    a = loop(a, 2)
+    float(jnp.sum(a[0, 0, 0:1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        a = loop(a, steps)
+        float(jnp.sum(a[0, 0, 0:1]))
+        best = min(best, (time.perf_counter() - t0 - rt) / steps)
+    return best, a
+
+
+def report(name, sec):
+    print(f"{name:44s} {sec*1e3:8.2f} ms  {N**3/sec/1e9:6.2f} Gcells/s", flush=True)
+
+
+def wrap_step_k1(gx=N):
+    hot_x, cold_x = gx // 3, gx * 2 // 3
+    in_r2 = (gx // 10 + 1) ** 2
+    X, Y, Z = N, N, N
+
+    def kernel(in_ref, d2_ref, out_ref, ring):
+        i = pl.program_id(0)
+        cur = in_ref[0]
+
+        @pl.when(i >= 2)
+        def _():
+            prev = ring[i % 2]
+            cent = ring[(i + 1) % 2]
+            val = (
+                prev
+                + cur
+                + pltpu.roll(cent, 1, 0)
+                + pltpu.roll(cent, Y - 1, 0)
+                + pltpu.roll(cent, 1, 1)
+                + pltpu.roll(cent, Z - 1, 1)
+            ) * (1.0 / 6.0)
+            x_g = (i - 1) % X
+            d2 = d2_ref[...]
+            val = jnp.where(d2 < in_r2 - (x_g - hot_x) ** 2, HOT, val)
+            val = jnp.where(d2 < in_r2 - (x_g - cold_x) ** 2, COLD, val)
+            out_ref[0] = val
+
+        @pl.when(i < 2)
+        def _():
+            out_ref[0] = cur  # placeholder; rewritten at steps X, X+1
+
+        ring[i % 2] = cur
+
+    cy, cz = N // 2, N // 2
+    y = jnp.arange(N)
+    d2 = ((y - cy) ** 2)[:, None] + ((y - cz) ** 2)[None, :]
+
+    def fn(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(X + 2,),
+            in_specs=[
+                pl.BlockSpec((1, Y, Z), lambda i: (i % X, 0, 0)),
+                pl.BlockSpec((Y, Z), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, Y, Z), lambda i: ((i - 1) % X, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((X, Y, Z), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((2, Y, Z), jnp.float32)],
+        )(x, d2.astype(jnp.int32))
+
+    return fn
+
+
+def wrap_step_k(K: int, gx=N):
+    """K planes per grid step: in0 = block j (K planes), in1 = next plane."""
+    hot_x, cold_x = gx // 3, gx * 2 // 3
+    in_r2 = (gx // 10 + 1) ** 2
+    X, Y, Z = N, N, N
+    G = X // K
+
+    def kernel(in_ref, nxt_ref, d2_ref, out_ref, ring):
+        j = pl.program_id(0)
+        d2 = d2_ref[...]
+        # ring[0] holds plane j*K - 1 (wrapped); compute outs [jK, jK+K)
+        for t in range(K):
+            prev = ring[0] if t == 0 else in_ref[t - 1]
+            cent = in_ref[t]
+            nxt = in_ref[t + 1] if t + 1 < K else nxt_ref[0]
+            val = (
+                prev
+                + nxt
+                + pltpu.roll(cent, 1, 0)
+                + pltpu.roll(cent, Y - 1, 0)
+                + pltpu.roll(cent, 1, 1)
+                + pltpu.roll(cent, Z - 1, 1)
+            ) * (1.0 / 6.0)
+            x_g = (j - 1) * K + t  # block j-1, j >= 1 when this runs
+            val = jnp.where(d2 < in_r2 - (x_g - hot_x) ** 2, HOT, val)
+            val = jnp.where(d2 < in_r2 - (x_g - cold_x) ** 2, COLD, val)
+            out_ref[t] = val
+        ring[0] = in_ref[K - 1]
+
+    cy, cz = N // 2, N // 2
+    y = jnp.arange(N)
+    d2 = ((y - cy) ** 2)[:, None] + ((y - cz) ** 2)[None, :]
+
+    def fn(x):
+        # grid step j handles planes [jK, (j+1)K); plane jK-1 comes from the
+        # ring, plane (j+1)K from the 1-plane second fetch.  First block's
+        # prev (plane -1 = X-1) seeded by an extra wrap step j = G (ring writes
+        # only) — instead: run grid G+1 with j==0 as a seed step.
+        def kernel_outer(in_ref, nxt_ref, d2_ref, out_ref, ring):
+            j = pl.program_id(0)
+
+            @pl.when(j == 0)
+            def _():
+                ring[0] = in_ref[K - 1]  # block G-1's last plane = X-1
+                out_ref[...] = in_ref[...]  # placeholder; rewritten at j == G
+
+            @pl.when(j > 0)
+            def _():
+                kernel(in_ref, nxt_ref, d2_ref, out_ref, ring)
+
+        return pl.pallas_call(
+            kernel_outer,
+            grid=(G + 1,),
+            in_specs=[
+                pl.BlockSpec((K, Y, Z), lambda j: ((j + G - 1) % G, 0, 0)),
+                pl.BlockSpec((1, Y, Z), lambda j: ((j % G) * K, 0, 0)),
+                pl.BlockSpec((Y, Z), lambda j: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((K, Y, Z), lambda j: ((j + G - 1) % G, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((X, Y, Z), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((1, Y, Z), jnp.float32)],
+        )(x, x, d2.astype(jnp.int32))
+
+    return fn
+
+
+def main():
+    rt = rt_s()
+    print(f"host RT {rt*1e3:.1f} ms", flush=True)
+
+    # full current model step (shell + exchange + plane kernel)
+    import sys
+    sys.path.insert(0, "/root/repo")
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    model = Jacobi3D(N, N, N, devices=[jax.devices()[0]], kernel_impl="pallas")
+    model.realize()
+    model.step(100)
+    float(jnp.sum(model.dd.get_curr(model.h)))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        model.step(100)
+        float(jnp.sum(model.dd.get_curr(model.h)))
+        best = min(best, (time.perf_counter() - t0 - rt) / 100)
+    report("current full model step (shell+exch)", best)
+
+    a = jnp.zeros((N, N, N), jnp.float32)
+    sec, a = timed(wrap_step_k1(), a, rt)
+    report("wrap kernel K=1 (no shell/exchange)", sec)
+
+    for K in (2, 4):
+        try:
+            sec, a = timed(wrap_step_k(K), a, rt)
+            report(f"wrap kernel K={K}", sec)
+        except Exception as e:
+            print(f"wrap K={K} FAILED: {type(e).__name__}: {str(e)[:250]}", flush=True)
+
+    # correctness cross-check: K=1 wrap vs K=2 wrap vs jnp roll formulation
+    b0 = jnp.asarray(np_init())
+    ref = jnp_step(b0)
+    for name, fn in [("K1", wrap_step_k1()), ("K2", wrap_step_k(2))]:
+        try:
+            out = fn(b0)
+            err = float(jnp.max(jnp.abs(out - ref)))
+            print(f"wrap {name} max err vs jnp roll: {err:.2e}", flush=True)
+        except Exception as e:
+            print(f"wrap {name} check FAILED: {str(e)[:200]}", flush=True)
+
+
+def np_init():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    return rng.random((N, N, N)).astype("float32")
+
+
+def jnp_step(x):
+    gx = N
+    hot_x, cold_x = gx // 3, gx * 2 // 3
+    in_r2 = (gx // 10 + 1) ** 2
+    val = (
+        jnp.roll(x, 1, 0)
+        + jnp.roll(x, -1, 0)
+        + jnp.roll(x, 1, 1)
+        + jnp.roll(x, -1, 1)
+        + jnp.roll(x, 1, 2)
+        + jnp.roll(x, -1, 2)
+    ) / 6.0
+    ix = jnp.arange(N)[:, None, None]
+    iy = jnp.arange(N)[None, :, None]
+    iz = jnp.arange(N)[None, None, :]
+    d2yz = (iy - N // 2) ** 2 + (iz - N // 2) ** 2
+    val = jnp.where(d2yz + (ix - hot_x) ** 2 < in_r2, HOT, val)
+    val = jnp.where(d2yz + (ix - cold_x) ** 2 < in_r2, COLD, val)
+    return val
+
+
+if __name__ == "__main__":
+    main()

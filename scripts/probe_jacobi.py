@@ -1,0 +1,150 @@
+"""Hardware probe: where does the jacobi plane kernel lose its bandwidth?
+
+Variants isolate DMA pipeline vs ring copy vs shifted-window compute vs the
+unaligned [1:-1,1:-1] masked write.  Run on chip: python scripts/probe_jacobi.py
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+X = Y = Z = 514  # shell-carrying 512^3
+STEPS = 30
+
+
+def rt_s() -> float:
+    x = jnp.zeros((8,))
+    float(jnp.sum(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(jnp.sum(x))
+    return (time.perf_counter() - t0) / 5
+
+
+def timed(fn, a, rt, steps=STEPS):
+    @partial(jax.jit, donate_argnums=0, static_argnums=1)
+    def loop(a, s):
+        return lax.fori_loop(0, s, lambda _, x: fn(x), a)
+
+    a = loop(a, 2)
+    float(jnp.sum(a[0, 0, 0:1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        a = loop(a, steps)
+        float(jnp.sum(a[0, 0, 0:1]))
+        best = min(best, (time.perf_counter() - t0 - rt) / steps)
+    return best, a
+
+
+def report(name, sec):
+    cells = 512**3
+    print(f"{name:40s} {sec*1e3:8.2f} ms  {cells/sec/1e9:6.2f} Gcells/s", flush=True)
+
+
+def plane_kernel(body_fn):
+    """Shared plane-pipeline scaffold: ring of 2, pass-through halo planes."""
+
+    def kernel(in_ref, out_ref, ring):
+        i = pl.program_id(0)
+        cur = in_ref[0]
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[0] = cur
+
+        @pl.when(jnp.logical_and(i >= 2, i <= X - 1))
+        def _():
+            body_fn(out_ref, ring[i % 2], ring[(i + 1) % 2], cur)
+
+        @pl.when(i == X)
+        def _():
+            out_ref[0] = ring[(i + 1) % 2]
+
+        @pl.when(i <= X - 1)
+        def _():
+            ring[i % 2] = cur
+
+    def fn(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(X + 1,),
+            in_specs=[pl.BlockSpec((1, Y, Z), lambda i: (jnp.minimum(i, X - 1), 0, 0))],
+            out_specs=pl.BlockSpec((1, Y, Z), lambda i: (jnp.clip(i - 1, 0, X - 1), 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((X, Y, Z), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((2, Y, Z), jnp.float32)],
+        )(x)
+
+    return fn
+
+
+def body_passthrough(out_ref, prev, cent, cur):
+    out_ref[0] = cent
+
+
+def body_x_only(out_ref, prev, cent, cur):
+    out_ref[0] = (prev + cent + cur) / 3.0
+
+
+def body_mean6_full(out_ref, prev, cent, cur):
+    """Full-plane rolls + whole-plane aligned write (halo ring gets garbage —
+    legal: the next exchange refills every halo cell before any read)."""
+    val = (
+        prev
+        + cur
+        + pltpu.roll(cent, 1, 0)
+        + pltpu.roll(cent, -1, 0)
+        + pltpu.roll(cent, 1, 1)
+        + pltpu.roll(cent, -1, 1)
+    ) / 6.0
+    out_ref[0] = val
+
+
+def body_mean6_window(out_ref, prev, cent, cur):
+    """Current style: windowed shifts + masked [1:-1,1:-1] write."""
+    mean = (
+        prev[1:-1, 1:-1]
+        + cur[1:-1, 1:-1]
+        + cent[:-2, 1:-1]
+        + cent[2:, 1:-1]
+        + cent[1:-1, :-2]
+        + cent[1:-1, 2:]
+    ) / 6.0
+    out_ref[0] = cent
+    out_ref[0, 1:-1, 1:-1] = mean
+
+
+def main():
+    rt = rt_s()
+    print(f"host RT {rt*1e3:.1f} ms", flush=True)
+    a = jnp.zeros((X, Y, Z), jnp.float32)
+
+    from stencil_tpu.ops.jacobi_pallas import jacobi_plane_step, yz_dist2_plane
+
+    origin = jnp.zeros((3,), jnp.int32)
+    yz_d2 = yz_dist2_plane(0, 0, (Y - 2, Z - 2), (512, 512, 512))
+
+    variants = [
+        ("A current jacobi_plane_step", lambda x: jacobi_plane_step(x, origin, yz_d2, (512, 512, 512))),
+        ("B ring passthrough (no compute)", plane_kernel(body_passthrough)),
+        ("C x-neighbors only (no rotates)", plane_kernel(body_x_only)),
+        ("D mean6 full-plane rolls", plane_kernel(body_mean6_full)),
+        ("E mean6 windowed+masked write", plane_kernel(body_mean6_window)),
+    ]
+    for name, fn in variants:
+        try:
+            sec, a = timed(fn, a, rt)
+            report(name, sec)
+        except Exception as e:
+            print(f"{name} FAILED: {type(e).__name__}: {str(e)[:300]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

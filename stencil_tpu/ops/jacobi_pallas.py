@@ -37,6 +37,13 @@ HOT_TEMP = 1.0
 COLD_TEMP = 0.0
 
 
+def sphere_params(gx: int):
+    """hot/cold sphere x-centers and the integer membership bound
+    d2 < (r+1)^2 (the truncated-float-sqrt test, jacobi3d.cu:31-33 — see
+    models/jacobi.py for the exact-equivalence bound)."""
+    return gx // 3, gx * 2 // 3, (gx // 10 + 1) ** 2
+
+
 def yz_dist2_plane(origin_y, origin_z, shape_yz: Tuple[int, int], global_size) -> jax.Array:
     """(y - gy/2)^2 + (z - gz/2)^2 over the interior plane, wrapped
     periodically; shared by both spheres (same y/z center)."""
@@ -45,6 +52,83 @@ def yz_dist2_plane(origin_y, origin_z, shape_yz: Tuple[int, int], global_size) -
     y = (origin_y + jnp.arange(shape_yz[0])) % gy
     z = (origin_z + jnp.arange(shape_yz[1])) % gz
     return ((y - cy) ** 2)[:, None] + ((z - cz) ** 2)[None, :]
+
+
+def jacobi_wrap_step(
+    block: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """One Jacobi iteration over the WHOLE (unsharded) domain with periodic
+    wrap folded into the kernel — the single-device fast path.
+
+    With one device there is no neighbor: the reference still runs its
+    same-GPU ``PeerAccessSender`` translate kernels to fill the shell
+    (tx_cuda.cuh:39-104); here the shell disappears entirely.  The x-wrap
+    rides the block index map (``i % X``: planes 0 and 1 are re-fetched after
+    the last plane so planes X-1 and 0 can close the ring), and the y/z wrap
+    is a lane/sublane rotate of the resident plane — measured free against
+    the plane DMA (scripts/probe3.py: 45.7 Gcells/s vs 16.3 for the
+    shell+exchange formulation on the same chip/day).
+
+    ``block`` is the bare (X, Y, Z) logical domain; semantics match
+    ``models.jacobi.Jacobi3D._kernel`` exactly (verified bit-exact against
+    the jnp.roll formulation on hardware).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    X, Y, Z = block.shape
+    gx = X
+    hot_x, cold_x, in_r2 = sphere_params(gx)
+
+    def roll(v, amt, axis):
+        if interpret:
+            return jnp.roll(v, amt, axis)
+        return pltpu.roll(v, amt % v.shape[axis], axis)
+
+    def kernel(in_ref, d2_ref, out_ref, ring):
+        i = pl.program_id(0)
+        cur = in_ref[0]
+
+        @pl.when(i >= 2)
+        def _():
+            prev = ring[i % 2]  # plane (i-2) % X
+            cent = ring[(i + 1) % 2]  # plane (i-1) % X
+            val = (
+                prev
+                + cur
+                + roll(cent, 1, 0)
+                + roll(cent, -1, 0)
+                + roll(cent, 1, 1)
+                + roll(cent, -1, 1)
+            ) / 6.0
+            x_g = (i - 1) % X
+            d2 = d2_ref[...]
+            val = jnp.where(d2 < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
+            val = jnp.where(d2 < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
+            out_ref[0] = val.astype(cur.dtype)
+
+        @pl.when(i < 2)
+        def _():
+            out_ref[0] = cur  # placeholder; rewritten at steps X, X+1
+
+        ring[i % 2] = cur
+
+    d2 = yz_dist2_plane(0, 0, (Y, Z), block.shape)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(X + 2,),
+        in_specs=[
+            pl.BlockSpec((1, Y, Z), lambda i: (i % X, 0, 0)),
+            # constant index map: fetched once, stays resident in VMEM
+            pl.BlockSpec((Y, Z), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Y, Z), lambda i: ((i - 1) % X, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
+        scratch_shapes=[pltpu.VMEM((2, Y, Z), block.dtype)],
+        interpret=interpret,
+    )(block, d2.astype(jnp.int32))
 
 
 def jacobi_plane_step(
@@ -60,9 +144,7 @@ def jacobi_plane_step(
 
     X, Y, Z = block.shape
     gx = global_size[0]
-    hot_x = gx // 3
-    cold_x = gx * 2 // 3
-    in_r2 = (gx // 10 + 1) ** 2  # d2 < (r+1)^2  <=>  floor(sqrt(d2)) <= r
+    hot_x, cold_x, in_r2 = sphere_params(gx)
 
     def kernel(origin_ref, in_ref, d2_ref, out_ref, ring):
         i = pl.program_id(0)

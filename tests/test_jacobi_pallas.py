@@ -36,3 +36,17 @@ def test_pallas_single_device_spheres_active():
     # hot sphere center (x=10, y=15, z=15) clamped hot
     assert t[10, 15, 15] == pytest.approx(1.0)
     assert t[20, 15, 15] == pytest.approx(0.0)
+
+
+def test_wrap_fast_path_matches_jnp_single_device():
+    """Single-device pallas uses the wrap-in-kernel path (no shell reads, no
+    exchange); must equal the generic make_step formulation exactly."""
+    dev = jax.devices()[:1]
+    a = Jacobi3D(26, 24, 22, devices=dev)
+    a.realize()
+    b = Jacobi3D(26, 24, 22, kernel_impl="pallas", interpret=True, devices=dev)
+    b.realize()
+    assert b.dd.num_subdomains() == 1
+    a.step(5)
+    b.step(5)
+    np.testing.assert_allclose(a.temperature(), b.temperature(), rtol=1e-6)

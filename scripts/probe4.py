@@ -1,0 +1,173 @@
+"""Probe 4: manual-DMA jacobi wrap kernel (deeper in-flight pipeline than the
+automatic 2-deep blocked pipeline).  Run on chip."""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 512
+HOT, COLD = 1.0, 0.0
+
+
+def rt_s() -> float:
+    x = jnp.zeros((8,))
+    float(jnp.sum(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(jnp.sum(x))
+    return (time.perf_counter() - t0) / 5
+
+
+def timed(fn, a, rt, steps=100):
+    @partial(jax.jit, donate_argnums=0, static_argnums=1)
+    def loop(a, s):
+        return lax.fori_loop(0, s, lambda _, x: fn(x), a)
+
+    a = loop(a, 2)
+    float(jnp.sum(a[0, 0, 0:1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        a = loop(a, steps)
+        float(jnp.sum(a[0, 0, 0:1]))
+        best = min(best, (time.perf_counter() - t0 - rt) / steps)
+    return best, a
+
+
+def manual_jacobi(depth=4, ring=6, oring=3):
+    X, Y, Z = N, N, N
+    gx = X
+    hot_x, cold_x = gx // 3, gx * 2 // 3
+    in_r2 = (gx // 10 + 1) ** 2
+
+    def kernel(in_hbm, d2_ref, out_hbm, vin, vout, in_sems, out_sems):
+        def cp_in(i):
+            # step i fetches plane i % X into slot i % ring
+            return pltpu.make_async_copy(
+                in_hbm.at[i % X], vin.at[i % ring], in_sems.at[i % ring]
+            )
+
+        def cp_out(i):
+            # step i (>= 2) wrote out plane (i-1) % X from slot i % oring
+            return pltpu.make_async_copy(
+                vout.at[i % oring], out_hbm.at[(i - 1) % X], out_sems.at[i % oring]
+            )
+
+        for i in range(depth):
+            cp_in(i).start()
+
+        d2 = d2_ref[...]
+
+        def body(i, _):
+            cp_in(i).wait()
+
+            @pl.when(i >= 2)
+            def _():
+                @pl.when(i - 2 >= oring)
+                def _():
+                    cp_out(i - oring).wait()
+
+                prev = vin[(i - 2) % ring]
+                cent = vin[(i - 1) % ring]
+                cur = vin[i % ring]
+                val = (
+                    prev
+                    + cur
+                    + pltpu.roll(cent, 1, 0)
+                    + pltpu.roll(cent, Y - 1, 0)
+                    + pltpu.roll(cent, 1, 1)
+                    + pltpu.roll(cent, Z - 1, 1)
+                ) / 6.0
+                x_g = (i - 1) % X
+                val = jnp.where(d2 < in_r2 - (x_g - hot_x) ** 2, HOT, val)
+                val = jnp.where(d2 < in_r2 - (x_g - cold_x) ** 2, COLD, val)
+                vout[i % oring] = val
+                cp_out(i).start()
+
+            @pl.when(i + depth <= X + 1)
+            def _():
+                cp_in(i + depth).start()
+
+            return 0
+
+        lax.fori_loop(0, X + 2, body, 0, unroll=False)
+        # drain: outs started at steps [2, X+2); waited in-loop for steps
+        # [2+oring, X+2) - oring ... i.e. out-step indices [2, X+2-oring)
+        for j in range(oring):
+            cp_out(X + 2 - oring + j).wait()
+
+    cy, cz = N // 2, N // 2
+    y = jnp.arange(N)
+    d2 = ((y - cy) ** 2)[:, None] + ((y - cz) ** 2)[None, :]
+
+    def fn(x):
+        return pl.pallas_call(
+            kernel,
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec((Y, Z), lambda: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_shape=jax.ShapeDtypeStruct((X, Y, Z), jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((ring, Y, Z), jnp.float32),
+                pltpu.VMEM((oring, Y, Z), jnp.float32),
+                pltpu.SemaphoreType.DMA((ring,)),
+                pltpu.SemaphoreType.DMA((oring,)),
+            ],
+        )(x, d2.astype(jnp.int32))
+
+    return fn
+
+
+def jnp_step(x):
+    gx = N
+    hot_x, cold_x = gx // 3, gx * 2 // 3
+    in_r2 = (gx // 10 + 1) ** 2
+    val = (
+        jnp.roll(x, 1, 0)
+        + jnp.roll(x, -1, 0)
+        + jnp.roll(x, 1, 1)
+        + jnp.roll(x, -1, 1)
+        + jnp.roll(x, 1, 2)
+        + jnp.roll(x, -1, 2)
+    ) / 6.0
+    ix = jnp.arange(N)[:, None, None]
+    iy = jnp.arange(N)[None, :, None]
+    iz = jnp.arange(N)[None, None, :]
+    d2yz = (iy - N // 2) ** 2 + (iz - N // 2) ** 2
+    val = jnp.where(d2yz + (ix - hot_x) ** 2 < in_r2, HOT, val)
+    val = jnp.where(d2yz + (ix - cold_x) ** 2 < in_r2, COLD, val)
+    return val
+
+
+def main():
+    import numpy as np
+
+    rt = rt_s()
+    print(f"host RT {rt*1e3:.1f} ms", flush=True)
+    rng = np.random.default_rng(0)
+    b0 = jnp.asarray(rng.random((N, N, N)).astype("float32"))
+    ref = jnp_step(b0)
+    for depth, ring, oring in [(4, 6, 3), (6, 8, 4)]:
+        try:
+            fn = manual_jacobi(depth, ring, oring)
+            out = fn(b0)
+            err = float(jnp.max(jnp.abs(out - ref)))
+            print(f"manual d={depth} r={ring} o={oring} max err: {err:.2e}", flush=True)
+            a = jnp.zeros((N, N, N), jnp.float32)
+            sec, a = timed(fn, a, rt)
+            print(f"manual d={depth} r={ring} o={oring}: {sec*1e3:.2f} ms  {N**3/sec/1e9:.2f} Gcells/s", flush=True)
+        except Exception as e:
+            print(f"manual d={depth} FAILED: {type(e).__name__}: {str(e)[:300]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Probe 5: cost anatomy of the 3-axis-sweep exchange at 518^3.
+
+Which op burns the 10 ms: slab extraction, DUS halo writes (per axis), the
+self-ppermute, or copy amplification?  Run on chip."""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+R = 3
+N = 512 + 2 * R  # 518
+
+
+def rt_s() -> float:
+    x = jnp.zeros((8,))
+    float(jnp.sum(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(jnp.sum(x))
+    return (time.perf_counter() - t0) / 5
+
+
+def timed(fn, a, rt, steps=30):
+    @partial(jax.jit, donate_argnums=0, static_argnums=1)
+    def loop(a, s):
+        return lax.fori_loop(0, s, lambda _, x: fn(x), a)
+
+    a = loop(a, 2)
+    float(jnp.sum(a[0, 0, 0:1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        a = loop(a, steps)
+        float(jnp.sum(a[0, 0, 0:1]))
+        best = min(best, (time.perf_counter() - t0 - rt) / steps)
+    return best, a
+
+
+def report(name, sec):
+    print(f"{name:46s} {sec*1e3:8.3f} ms", flush=True)
+
+
+def main():
+    rt = rt_s()
+    print(f"host RT {rt*1e3:.1f} ms", flush=True)
+    a = jnp.zeros((N, N, N), jnp.float32)
+
+    cases = []
+
+    # slab extraction only (forces materialization via tiny dependency)
+    def extract_x(b):
+        s = b[R : 2 * R, :, :]
+        return b.at[0, 0, 0].set(s[0, 0, 0])
+
+    def extract_z(b):
+        s = b[:, :, R : 2 * R]
+        return b.at[0, 0, 0].set(s[0, 0, 0])
+
+    # DUS halo writes, same-source slab (no permute)
+    def dus_x(b):
+        s = b[R : 2 * R, :, :]
+        b = lax.dynamic_update_slice(b, s, (N - R, 0, 0))
+        return lax.dynamic_update_slice(b, s, (0, 0, 0))
+
+    def dus_y(b):
+        s = b[:, R : 2 * R, :]
+        b = lax.dynamic_update_slice(b, s, (0, N - R, 0))
+        return lax.dynamic_update_slice(b, s, (0, 0, 0))
+
+    def dus_z(b):
+        s = b[:, :, R : 2 * R]
+        b = lax.dynamic_update_slice(b, s, (0, 0, N - R))
+        return lax.dynamic_update_slice(b, s, (0, 0, 0))
+
+    # concat rebuild along z (explicit single full copy)
+    def concat_z(b):
+        lo = b[:, :, R : 2 * R]
+        hi = b[:, :, N - 2 * R : N - R]
+        return jnp.concatenate([hi, b[:, :, R : N - R], lo], axis=2)
+
+    # x-axis DUS with ppermute self-wrap in a (1,1,1)-mesh shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh([[[jax.devices()[0]]]], ("x", "y", "z"))
+
+    def perm_z(b):
+        def f(blk):
+            s = blk[:, :, R : 2 * R]
+            r = lax.ppermute(s, "z", [(0, 0)])
+            blk = lax.dynamic_update_slice(blk, r, (0, 0, N - R))
+            s2 = blk[:, :, N - 2 * R : N - R]
+            r2 = lax.ppermute(s2, "z", [(0, 0)])
+            return lax.dynamic_update_slice(blk, r2, (0, 0, 0))
+
+        return jax.shard_map(f, mesh=mesh, in_specs=P("x", "y", "z"), out_specs=P("x", "y", "z"))(b)
+
+    cases = [
+        ("extract x slab", extract_x),
+        ("extract z slab", extract_z),
+        ("DUS x (lo+hi)", dus_x),
+        ("DUS y (lo+hi)", dus_y),
+        ("DUS z (lo+hi)", dus_z),
+        ("concat rebuild z", concat_z),
+        ("shardmap ppermute+DUS z", perm_z),
+    ]
+    for name, fn in cases:
+        try:
+            sec, a = timed(fn, a, rt)
+            report(name, sec)
+        except Exception as e:
+            print(f"{name} FAILED: {type(e).__name__}: {str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

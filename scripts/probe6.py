@@ -1,0 +1,99 @@
+"""Probe 6: bisect the real halo_exchange_shard cost per axis at 518^3."""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.ops.exchange import halo_exchange_shard
+
+R = 3
+N = 512 + 2 * R
+
+
+def rt_s() -> float:
+    x = jnp.zeros((8,))
+    float(jnp.sum(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(jnp.sum(x))
+    return (time.perf_counter() - t0) / 5
+
+
+def timed(fn, a, rt, steps=30):
+    @partial(jax.jit, donate_argnums=0, static_argnums=1)
+    def loop(a, s):
+        return lax.fori_loop(0, s, lambda _, x: fn(x), a)
+
+    a = loop(a, 2)
+    float(jnp.sum(a[0, 0, 0:1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        a = loop(a, steps)
+        float(jnp.sum(a[0, 0, 0:1]))
+        best = min(best, (time.perf_counter() - t0 - rt) / steps)
+    return best, a
+
+
+def main():
+    rt = rt_s()
+    print(f"host RT {rt*1e3:.1f} ms", flush=True)
+    mesh = Mesh([[[jax.devices()[0]]]], ("x", "y", "z"))
+    a = jnp.zeros((N, N, N), jnp.float32)
+
+    def radius_for(axes):
+        r = Radius.constant(0)
+        from stencil_tpu.core.dim3 import Dim3
+
+        for ax in axes:
+            d = [0, 0, 0]
+            d[ax] = 1
+            r.set_dir(Dim3(*d), R)
+            d[ax] = -1
+            r.set_dir(Dim3(*d), R)
+        return r
+
+    for name, axes in [("x only", [0]), ("y only", [1]), ("z only", [2]), ("xyz", [0, 1, 2])]:
+        r = radius_for(axes)
+
+        def fn(b, r=r):
+            return jax.shard_map(
+                lambda blk: halo_exchange_shard(blk, r, (1, 1, 1)),
+                mesh=mesh,
+                in_specs=P("x", "y", "z"),
+                out_specs=P("x", "y", "z"),
+                check_vma=False,
+            )(b)
+
+        sec, a = timed(fn, a, rt)
+        print(f"halo_exchange_shard {name:8s} {sec*1e3:8.3f} ms", flush=True)
+
+    # full uniform radius via Radius.constant (26-dir, same widths)
+    r = Radius.constant(R)
+
+    def fn2(b):
+        return jax.shard_map(
+            lambda blk: halo_exchange_shard(blk, r, (1, 1, 1)),
+            mesh=mesh,
+            in_specs=P("x", "y", "z"),
+            out_specs=P("x", "y", "z"),
+                check_vma=False,
+        )(b)
+
+    sec, a = timed(fn2, a, rt)
+    print(f"halo_exchange_shard uniform  {sec*1e3:8.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

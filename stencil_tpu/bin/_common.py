@@ -61,6 +61,19 @@ def parse_strategy(args) -> PlacementStrategy:
     return PlacementStrategy.Trivial if args.trivial else PlacementStrategy.NodeAware
 
 
+def host_round_trip_s() -> float:
+    """Latency of one device->host readback (large through a tunneled dev
+    backend; subtract it from device-looped timings — see bench.py)."""
+    import jax.numpy as jnp
+
+    x = jnp.zeros((8,))
+    float(jnp.sum(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(jnp.sum(x))
+    return (time.perf_counter() - t0) / 5
+
+
 def ranks_and_devcount():
     """(MPI size, per-process device count) analogs."""
     return jax.process_count(), jax.local_device_count()

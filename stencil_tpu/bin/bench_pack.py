@@ -77,6 +77,49 @@ def bench(sz: Dim3, direction: Dim3, n_iters: int, backend: str, interpret: bool
     return plan.size, pack_t, unpack_t
 
 
+def bench_roundtrip(sz: Dim3, direction: Dim3, n_iters: int, inner: int, backend: str, interpret: bool, rt: float):
+    """pack->unpack round trips, ``inner`` per device dispatch with the host
+    round trip subtracted — the honest protocol for tunneled backends (per-
+    call sync costs ~100 ms there; see bench.py).  Returns
+    (bytes, seconds per round trip)."""
+    from functools import partial
+
+    from jax import lax
+
+    spec = LocalSpec.make(sz, Dim3(0, 0, 0), Radius.constant(3))
+    raw = tuple(spec.raw_size())
+    rng = np.random.default_rng(0)
+    block = jnp.asarray(rng.random(raw), dtype=jnp.float32)
+
+    if backend == "pallas":
+        pack, plan = make_pack_fn_pallas(spec, [direction], jnp.float32, interpret=interpret)
+        unpack, _ = make_unpack_fn_pallas(spec, [direction], jnp.float32, interpret=interpret)
+
+        def one(b):
+            return unpack(b, pack(b))
+
+    else:
+        pack, plan = make_pack_fn(spec, [direction], [jnp.float32])
+        unpack, _ = make_unpack_fn(spec, [direction], [jnp.float32])
+
+        def one(b):
+            return unpack(pack([b]), [b])[0]
+
+    @partial(jax.jit, donate_argnums=0, static_argnums=1)
+    def loop(b, s):
+        return lax.fori_loop(0, s, lambda _, x: one(x), b)
+
+    block = loop(block, 2)
+    float(jnp.sum(block[0, 0, 0:1]))  # honest completion through the tunnel
+    best = float("inf")
+    for _ in range(n_iters):
+        t0 = time.perf_counter()
+        block = loop(block, inner)
+        float(jnp.sum(block[0, 0, 0:1]))
+        best = min(best, max(time.perf_counter() - t0 - rt, 0.0) / inner)
+    return plan.size, best
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("bench-pack")
     p.add_argument("--iters", type=int, default=30)
@@ -87,9 +130,27 @@ def main(argv=None) -> int:
         action="store_true",
         help="run pallas kernels in interpreter mode (CPU testing)",
     )
+    p.add_argument(
+        "--inner",
+        type=int,
+        default=1,
+        help="pack+unpack round trips per device dispatch (use >1 on "
+        "tunneled backends; prints roundtrip time instead of pack/unpack)",
+    )
     args = p.parse_args(argv)
 
     ext = Dim3(args.size, args.size, args.size)
+    if args.inner > 1:
+        from stencil_tpu.bin._common import host_round_trip_s
+
+        rt = host_round_trip_s()
+        for d in (Dim3(1, 0, 0), Dim3(0, 1, 0), Dim3(0, 0, 1)):
+            nbytes, rt_t = bench_roundtrip(
+                ext, d, max(args.iters, 3), args.inner, args.backend, args.interpret, rt
+            )
+            gbps = 2 * nbytes / rt_t / 1e9  # payload packed + unpacked
+            print(f"{ext} {d} {nbytes} roundtrip {rt_t:g} {gbps:.2f}GB/s")
+        return 0
     for d in (Dim3(1, 0, 0), Dim3(0, 1, 0), Dim3(0, 0, 1)):
         nbytes, pack_t, unpack_t = bench(ext, d, args.iters, args.backend, args.interpret)
         gbps = nbytes / min(pack_t, unpack_t) / 1e9

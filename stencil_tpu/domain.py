@@ -152,6 +152,7 @@ class DistributedDomain:
         self._curr: Dict[str, jax.Array] = {}
         self._next: Dict[str, jax.Array] = {}
         self._exchange_fn = None
+        self._exchange_many_fn = None
         self._exchange_count = 0
         self._halo_mult = 1
         self._shell_radius: Optional[Radius] = None
@@ -237,6 +238,17 @@ class DistributedDomain:
         r = self._shell_radius = self._radius.scaled(self._halo_mult)
         max_r = max(r.lo().x, r.lo().y, r.lo().z, r.hi().x, r.hi().y, r.hi().z)
         min_valid = min(v if v is not None else n[ax] for ax, v in enumerate(vlast))
+        if min_valid <= 0:
+            # pad-and-mask confines the remainder to ONE trailing shard; a
+            # split where (dim-1)*ceil(size/dim) >= size (e.g. 10 cells over
+            # 8 shards) leaves the last shard empty.  The reference spreads
+            # +-1-cell remainders across shards instead (partition.hpp:83-114)
+            # — that scheme has no equal-shard analog, so reject explicitly.
+            raise ValueError(
+                f"axis remainder does not fit in one trailing shard: size "
+                f"{self._size} over mesh {dim} gives last-shard valid cells "
+                f"{vlast}; choose a mesh dim with (dim-1)*ceil(size/dim) < size"
+            )
         if min(n.x, n.y, n.z) < max_r or min_valid < max_r:
             raise ValueError(
                 f"subdomain {n} (last-shard valid {vlast}) smaller than radius shell"
@@ -407,10 +419,29 @@ class DistributedDomain:
         t0 = time.perf_counter() if self._exchange_stats else 0.0
         self._curr = self._exchange_fn(self._curr)
         if self._exchange_stats:
-            for a in self._curr.values():
-                a.block_until_ready()
+            # honest sync: plain block_until_ready returns before execution
+            # finishes on tunneled dev backends (see block_until_ready below)
+            self.block_until_ready()
             self.stats.time_exchange += time.perf_counter() - t0
         self._exchange_count += 1
+
+    def exchange_many(self, steps: int) -> None:
+        """Run ``steps`` exchanges in ONE device dispatch (``lax.fori_loop``
+        over the exchange).  Timing helper for tunneled dev backends where a
+        per-call honest sync costs a host round trip (~100 ms) that would
+        swamp the exchange itself; exchanging is idempotent on a filled
+        domain, so looping it measures steady-state exchange cost."""
+        assert self._realized
+        if self._exchange_many_fn is None:
+            inner = self._exchange_fn
+
+            @partial(jax.jit, static_argnums=1, donate_argnums=0)
+            def many(arrays, s):
+                return lax.fori_loop(0, s, lambda _, a: inner(a), arrays)
+
+            self._exchange_many_fn = many
+        self._curr = self._exchange_many_fn(self._curr, steps)
+        self._exchange_count += steps
 
     def swap(self) -> None:
         """Swap curr/next slots (src/stencil.cu:541-561)."""
@@ -615,11 +646,13 @@ class DistributedDomain:
 
         @partial(jax.jit, static_argnums=1, **donate_kw)
         def step(curr: Dict[str, jax.Array], steps: int = 1) -> Dict[str, jax.Array]:
+            # check_vma off: the exchange's pallas blend kernels carry no vma
             fn = jax.shard_map(
                 partial(per_shard, steps),
                 mesh=self.mesh,
                 in_specs=tuple(spec for _ in names),
                 out_specs=tuple(spec for _ in names),
+                check_vma=False,
             )
             outs = fn(*[curr[k] for k in names])
             return dict(zip(names, outs))

@@ -175,10 +175,12 @@ class Jacobi3D:
         def dist2(c: Dim3):
             return (cx - c.x) ** 2 + (cy - c.y) ** 2 + (cz - c.z) ** 2
 
-        # the reference's truncated-float-sqrt membership (jacobi3d.cu:31-33)
-        # floor(sqrt(d2)) <= r  is exactly  d2 < (r+1)^2  for integer d2 up to
-        # 2^24 (d2 exactly representable in f32; sqrt cannot round across the
-        # integer boundary at these magnitudes) — so skip the sqrt entirely
+        # the reference's truncated-float-sqrt membership (jacobi3d.cu:31-33):
+        # floor(sqrtf(d2)) <= r  is exactly  d2 < (r+1)^2  while
+        # (r+1)*ulp(r+1) < 1, i.e. r+1 < ~2896 (gx up to ~29,000 at
+        # r = gx/10) — beyond that correctly-rounded sqrtf((r+1)^2 - 1)
+        # rounds up to exactly r+1 and the predicates diverge.  Amply
+        # satisfied at realistic sizes, so skip the sqrt entirely.
         in_r2 = (sphere_r + 1) ** 2
         val = jnp.where(dist2(hot_c) < in_r2, HOT_TEMP, val)
         val = jnp.where(dist2(cold_c) < in_r2, COLD_TEMP, val)

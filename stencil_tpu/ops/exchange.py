@@ -195,13 +195,33 @@ def halo_exchange_multi(
             # r_hi, written right after MY valid cells
             slabs = [b[axslice(b, r_lo, r_lo + r_hi)] for b in blocks]
             hi_recv = _fused_shift(slabs, _shift_from_high, name, n_dev)
+        # y/z halo writes go through tile-local pallas blend kernels where
+        # possible: plain DUS slivers on those axes bait XLA's layout
+        # assignment into transposing the whole array (two full-domain
+        # relayout copies per exchange — see ops/halo_blend.py).
+        from stencil_tpu.ops import halo_blend
+
+        blend = (
+            axis != 0
+            and not uneven
+            and halo_blend.enabled()
+            and all(b.ndim == 3 for b in blocks)
+        )
+        interp = halo_blend.interpret_mode()
         for j, b in enumerate(blocks):
             if lo_recv is not None:
-                b = b.at[axslice(b, 0, r_lo)].set(lo_recv[j])
+                if blend:
+                    b = halo_blend.blend_slab(b, lo_recv[j], axis, 0, interpret=interp)
+                else:
+                    b = b.at[axslice(b, 0, r_lo)].set(lo_recv[j])
             if hi_recv is not None:
                 if uneven:
                     b = lax.dynamic_update_slice(
                         b, hi_recv[j], dyn_starts(b, r_lo + n_valid)
+                    )
+                elif blend:
+                    b = halo_blend.blend_slab(
+                        b, hi_recv[j], axis, r_lo + n_pad, interpret=interp
                     )
                 else:
                     b = b.at[axslice(b, r_lo + n_pad, size)].set(hi_recv[j])
@@ -286,11 +306,14 @@ def make_exchange_fn(
             )
 
         leaves, treedef = jax.tree.flatten(arrays)
+        # check_vma off: the pallas blend kernels' outputs carry no vma
+        # annotation (same reason as the model pallas steps)
         shard_fn = jax.shard_map(
             per_shard,
             mesh=mesh,
             in_specs=tuple(spec for _ in leaves),
             out_specs=tuple(spec for _ in leaves),
+            check_vma=False,
         )
         return jax.tree.unflatten(treedef, list(shard_fn(*leaves)))
 

@@ -1,0 +1,152 @@
+"""Probe: fused slab-consuming jacobi path vs shell+exchange path, mesh [1,1,1].
+
+Measures on the real chip:
+  A. current shell path: halo_exchange_shard + jacobi_plane_step (BENCH_r01's 15.6 G)
+  B. new fused slab path: 6 ppermutes of bare face slabs + jacobi_slab_step
+  C. wrap fast path (upper bound)
+Checks B bit-exact vs C (self-permuted slabs == periodic wrap).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.ops.exchange import (
+    _shift_from_high,
+    _shift_from_low,
+    halo_exchange_shard,
+)
+from stencil_tpu.ops.jacobi_pallas import (
+    jacobi_plane_step,
+    jacobi_slab_step,
+    jacobi_wrap_step,
+    yz_dist2_plane,
+)
+
+SIZE = 512
+STEPS = 100
+
+
+def rt_s():
+    x = jnp.zeros((8,))
+    float(jnp.sum(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(jnp.sum(x))
+    return (time.perf_counter() - t0) / 5
+
+
+def timeit(fn, arr, rt):
+    out = fn(arr, STEPS)
+    float(jnp.sum(out[0, 0, 0:1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(out, STEPS)
+        float(jnp.sum(out[0, 0, 0:1]))
+        best = min(best, (time.perf_counter() - t0 - rt) / STEPS)
+    return out, best
+
+
+def main():
+    dev = jax.devices()[:1]
+    mesh = Mesh(np.array(dev).reshape(1, 1, 1), ("x", "y", "z"))
+    n = SIZE
+    gsize = (n, n, n)
+    key = jax.random.PRNGKey(0)
+    init_np = np.asarray(jax.random.uniform(key, (n, n, n), jnp.float32))
+    fresh = lambda: jnp.asarray(init_np)
+
+    rt = rt_s()
+    print(f"host rt: {rt*1e3:.1f} ms")
+
+    # --- C: wrap fast path (upper bound) -------------------------------------
+    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def wrap_loop(b, s):
+        return lax.fori_loop(0, s, lambda _, x: jacobi_wrap_step(x), b)
+
+    out_c, t_c = timeit(wrap_loop, fresh(), rt)
+    print(f"C wrap fast path:   {t_c*1e3:.3f} ms/iter  {n**3/t_c/1e9:.1f} Gcells/s")
+
+    # --- B: fused slab path ---------------------------------------------------
+    def per_shard_slab(s, b):
+        origin = jnp.stack([lax.axis_index(a) * n for a in ("x", "y", "z")])
+        d2 = yz_dist2_plane(origin[1], origin[2], (n, n), gsize)
+
+        def body(_, b):
+            xlo = _shift_from_low(b[n - 1], "x", 1)
+            xhi = _shift_from_high(b[0], "x", 1)
+            ylo = _shift_from_low(b[:, n - 1, :], "y", 1)
+            yhi = _shift_from_high(b[:, 0, :], "y", 1)
+            zlo = _shift_from_low(b[:, :, n - 1].T, "z", 1)
+            zhi = _shift_from_high(b[:, :, 0].T, "z", 1)
+            return jacobi_slab_step(
+                b, xlo, xhi, ylo, yhi, zlo, zhi, origin, d2, gsize
+            )
+
+        return lax.fori_loop(0, s, body, b)
+
+    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def slab_loop(b, s):
+        fn = jax.shard_map(
+            partial(per_shard_slab, s),
+            mesh=mesh,
+            in_specs=(P("x", "y", "z"),),
+            out_specs=P("x", "y", "z"),
+            check_vma=False,
+        )
+        return fn(b)
+
+    out_b, t_b = timeit(slab_loop, fresh(), rt)
+    print(f"B fused slab path:  {t_b*1e3:.3f} ms/iter  {n**3/t_b/1e9:.1f} Gcells/s")
+
+    # bit-exactness vs wrap path
+    a, c = np.asarray(out_b), np.asarray(out_c)
+    print(f"B vs C bit-exact: {np.array_equal(a, c)}  max|d|={np.abs(a - c).max():e}")
+
+    # --- A: current shell path ------------------------------------------------
+    r = Radius.constant(0)
+    r.set_face(1)
+    raw = n + 2
+
+    def per_shard_shell(s, blk):
+        origin = jnp.stack([lax.axis_index(a) * n for a in ("x", "y", "z")])
+        d2 = yz_dist2_plane(origin[1], origin[2], (n, n), gsize)
+
+        def body(_, b):
+            b = halo_exchange_shard(b, r, (1, 1, 1))
+            return jacobi_plane_step(b, origin, d2, gsize)
+
+        return lax.fori_loop(0, s, body, blk)
+
+    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def shell_loop(b, s):
+        fn = jax.shard_map(
+            partial(per_shard_shell, s),
+            mesh=mesh,
+            in_specs=(P("x", "y", "z"),),
+            out_specs=P("x", "y", "z"),
+            check_vma=False,
+        )
+        return fn(b)
+
+    shell_init = jnp.zeros((raw, raw, raw), jnp.float32)
+    shell_init = shell_init.at[1:-1, 1:-1, 1:-1].set(fresh())
+    out_a, t_a = timeit(shell_loop, shell_init, rt)
+    print(f"A shell path:       {t_a*1e3:.3f} ms/iter  {n**3/t_a/1e9:.1f} Gcells/s")
+
+    # shell path correctness vs wrap (interior)
+    ia = np.asarray(out_a)[1:-1, 1:-1, 1:-1]
+    print(f"A vs C bit-exact: {np.array_equal(ia, c)}")
+
+
+if __name__ == "__main__":
+    main()

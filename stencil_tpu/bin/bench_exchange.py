@@ -41,13 +41,14 @@ def bench(n_iters: int, n_quants: int, ext, radius: Radius, inner: int = 1, rt: 
     dd.realize()
     stats = Statistics()
     if inner > 1:
-        dd.exchange_many(inner)  # compile
-        dd.block_until_ready()
-        for _ in range(n_iters):
-            t0 = time.perf_counter()
-            dd.exchange_many(inner)
+        def run(k):
+            dd.exchange_many(k)
             dd.block_until_ready()
-            stats.insert(max(time.perf_counter() - t0 - rt, 0.0) / inner)
+
+        # auto-scaled so the rt subtraction can never clamp to 0.0
+        samples, _ = _common.timed_inner_loop(run, inner, rt, n_iters)
+        for s in samples:
+            stats.insert(s)
         return stats, dd.exchange_bytes_total()
     dd.exchange()  # compile
     dd.swap()

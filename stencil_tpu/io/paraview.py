@@ -23,21 +23,25 @@ def write_paraview(dd, prefix: str, zero_nans: bool = True) -> None:
     for i in range(dim.flatten()):
         idx = dd.placement.partition.idx(i)
         origin = Dim3(idx.x * n.x, idx.y * n.y, idx.z * n.z)
+        # uneven (padded) meshes: the trailing shard on a padded axis owns
+        # fewer VALID cells than the padded shard size n — dump only those
+        # (the reference's subdomains are exactly-sized, src/stencil.cu:884)
+        v = dd.shard_valid(idx)
         path = f"{prefix}_{i}.txt"
         # z-major row order, built vectorized (a Python per-cell loop is
         # unusable at the drivers' default 512^3)
         zz, yy, xx = np.meshgrid(
-            np.arange(origin.z, origin.z + n.z),
-            np.arange(origin.y, origin.y + n.y),
-            np.arange(origin.x, origin.x + n.x),
+            np.arange(origin.z, origin.z + v.z),
+            np.arange(origin.y, origin.y + v.y),
+            np.arange(origin.x, origin.x + v.x),
             indexing="ij",
         )
         cols = [zz.ravel(), yy.ravel(), xx.ravel()]
         for h in dd._handles:
             block = fields[h.name][
-                origin.x : origin.x + n.x,
-                origin.y : origin.y + n.y,
-                origin.z : origin.z + n.z,
+                origin.x : origin.x + v.x,
+                origin.y : origin.y + v.y,
+                origin.z : origin.z + v.z,
             ]
             vals = np.transpose(block, (2, 1, 0)).ravel().astype(np.float64)
             if zero_nans:

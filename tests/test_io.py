@@ -69,6 +69,32 @@ def test_write_paraview(tmp_path):
     assert float(v) == pytest.approx(0.0)
 
 
+def test_write_paraview_uneven(tmp_path):
+    """15x16x16 over a 2x2x2 mesh (padded x axis): trailing shards must dump
+    only their VALID cells, with true global origins."""
+    dd = DistributedDomain(15, 16, 16)
+    dd.set_radius(1)
+    dd.set_partition(2, 2, 2)
+    h = dd.add_data("q")
+    dd.realize()
+    dd.init_by_coords(h, lambda x, y, z: x * 1.5 + y * 0.25 + z)
+    prefix = str(tmp_path / "out")
+    write_paraview(dd, prefix)
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) == 8
+    rows = 0
+    want = np.fromfunction(
+        lambda x, y, z: x * 1.5 + y * 0.25 + z, (15, 16, 16), dtype=np.float64
+    )
+    for f in files:
+        lines = open(os.path.join(tmp_path, f)).read().splitlines()
+        rows += len(lines) - 1
+        for line in (lines[1], lines[-1]):  # spot-check first/last row of each
+            z, y, x, v = line.split(",")
+            assert float(v) == pytest.approx(want[int(x), int(y), int(z)])
+    assert rows == 15 * 16 * 16  # every valid cell exactly once, none padded
+
+
 def test_write_plan(tmp_path):
     dd, _ = _make_domain()
     path = dd.write_plan(str(tmp_path / "plan"))

@@ -155,6 +155,7 @@ class DistributedDomain:
         self._exchange_many_fn = None
         self._exchange_count = 0
         self._halo_mult = 1
+        self._shell_stale = False
         self._shell_radius: Optional[Radius] = None
         self._force_dim: Optional[Dim3] = None
         self.stats = DomainStats()
@@ -375,8 +376,26 @@ class DistributedDomain:
         arr = (self._curr if slot == "curr" else self._next)[h.name]
         return self._from_raw_global(np.asarray(jax.device_get(arr)))
 
+    def mark_shell_stale(self) -> None:
+        """Fast-path steps that skip the shell entirely (the single-device
+        wrap kernel; any path exchanging bare slabs) leave the carried shell
+        holding whatever the last real exchange wrote — arbitrarily old.
+        Models using such paths mark the shell stale so raw readback
+        re-exchanges first (``quantity_to_host`` reads interiors only and
+        never needs this)."""
+        self._shell_stale = True
+
     def raw_to_host(self, h: DataHandle, slot: str = "curr") -> np.ndarray:
-        """The raw shell-carrying global array (halos visible) for tests."""
+        """The raw shell-carrying global array (halos visible) for tests.
+
+        Halos reflect the most recent exchange — for the standard step paths
+        that is the exchange at the top of the last iteration (pre-compute
+        neighbor values, exactly the reference's shell contents between
+        exchanges).  A shell marked stale (``mark_shell_stale``) is first
+        refreshed with one production exchange so it is at least that fresh."""
+        if self._shell_stale and slot == "curr":
+            self._curr = self._exchange_fn(self._curr)
+            self._shell_stale = False
         arr = (self._curr if slot == "curr" else self._next)[h.name]
         return np.asarray(jax.device_get(arr))
 
@@ -418,6 +437,7 @@ class DistributedDomain:
         assert self._realized
         t0 = time.perf_counter() if self._exchange_stats else 0.0
         self._curr = self._exchange_fn(self._curr)
+        self._shell_stale = False
         if self._exchange_stats:
             # honest sync: plain block_until_ready returns before execution
             # finishes on tunneled dev backends (see block_until_ready below)
@@ -441,6 +461,7 @@ class DistributedDomain:
 
             self._exchange_many_fn = many
         self._curr = self._exchange_many_fn(self._curr, steps)
+        self._shell_stale = False
         self._exchange_count += steps
 
     def swap(self) -> None:
@@ -643,16 +664,23 @@ class DistributedDomain:
 
         spec = P(*MESH_AXES)
         donate_kw = {"donate_argnums": 0} if donate else {}
+        # vma validation stays on whenever the exchange's blend kernels can't
+        # engage — user kernels get full varying-manual-axes checking on the
+        # plain-DUS path
+        from stencil_tpu.ops import halo_blend
+
+        check_vma = halo_blend.vma_check(
+            [h.dtype for h in self._handles], self._valid_last
+        )
 
         @partial(jax.jit, static_argnums=1, **donate_kw)
         def step(curr: Dict[str, jax.Array], steps: int = 1) -> Dict[str, jax.Array]:
-            # check_vma off: the exchange's pallas blend kernels carry no vma
             fn = jax.shard_map(
                 partial(per_shard, steps),
                 mesh=self.mesh,
                 in_specs=tuple(spec for _ in names),
                 out_specs=tuple(spec for _ in names),
-                check_vma=False,
+                check_vma=check_vma,
             )
             outs = fn(*[curr[k] for k in names])
             return dict(zip(names, outs))

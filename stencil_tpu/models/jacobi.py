@@ -56,6 +56,9 @@ class Jacobi3D:
         self.kernel_impl = kernel_impl
         self.interpret = interpret
         self._step = None
+        # fast paths (wrap/slab kernels) advance interiors only; the carried
+        # shell goes stale and raw readback must re-exchange (mark_shell_stale)
+        self._marks_shell_stale = False
 
     def realize(self) -> None:
         self.dd.realize()
@@ -104,6 +107,7 @@ class Jacobi3D:
             lo = dd._shell_radius.lo()
             name = self.h.name
             interpret = self.interpret
+            self._marks_shell_stale = True
 
             @partial(jax.jit, static_argnums=1, donate_argnums=0)
             def step(curr, steps: int = 1):
@@ -188,6 +192,8 @@ class Jacobi3D:
 
     def step(self, steps: int = 1) -> None:
         self.dd.run_step(self._step, steps)
+        if self._marks_shell_stale:
+            self.dd.mark_shell_stale()
 
     def temperature(self) -> np.ndarray:
         return self.dd.quantity_to_host(self.h)

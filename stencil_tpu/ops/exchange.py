@@ -205,7 +205,7 @@ def halo_exchange_multi(
             axis != 0
             and not uneven
             and halo_blend.enabled()
-            and all(b.ndim == 3 for b in blocks)
+            and all(b.ndim == 3 and halo_blend.supports(b.dtype) for b in blocks)
         )
         interp = halo_blend.interpret_mode()
         for j, b in enumerate(blocks):
@@ -306,14 +306,17 @@ def make_exchange_fn(
             )
 
         leaves, treedef = jax.tree.flatten(arrays)
-        # check_vma off: the pallas blend kernels' outputs carry no vma
-        # annotation (same reason as the model pallas steps)
+        # vma validation stays on whenever the blend kernels can't engage
+        from stencil_tpu.ops import halo_blend
+
         shard_fn = jax.shard_map(
             per_shard,
             mesh=mesh,
             in_specs=tuple(spec for _ in leaves),
             out_specs=tuple(spec for _ in leaves),
-            check_vma=False,
+            check_vma=halo_blend.vma_check(
+                [l.dtype for l in leaves], valid_last, ndim_extra
+            ),
         )
         return jax.tree.unflatten(treedef, list(shard_fn(*leaves)))
 

@@ -33,24 +33,46 @@ import jax.numpy as jnp
 
 
 def enabled() -> bool:
-    """Use the blend kernels for y/z halo writes?  Auto: on for real
-    accelerator backends, off for CPU (where DUS has no relayout trap and
-    interpret-mode pallas would only slow tests).  Env override
-    ``STENCIL_HALO_BLEND=0|1`` forces either path (tests force 1 with
-    interpret mode to pin blend semantics against DUS)."""
+    """Use the blend kernels for y/z halo writes?  Auto: on for TPU only —
+    the relayout trap these kernels dodge is a property of TPU tiled layouts,
+    and the tile geometry below is TPU's; any other backend (cpu, gpu, dev
+    tunnels) takes the plain-DUS path it has actually been validated on.  Env
+    override ``STENCIL_HALO_BLEND=0|1`` forces either path (tests force 1
+    with interpret mode to pin blend semantics against DUS)."""
     env = os.environ.get("STENCIL_HALO_BLEND", "auto")
     if env == "0":
         return False
     if env == "1":
         return True
-    return jax.default_backend() != "cpu"
+    return jax.default_backend() == "tpu"
 
 
 def interpret_mode() -> bool:
-    return jax.default_backend() == "cpu"
+    return jax.default_backend() != "tpu"
 
 #: second-to-minor (sublane) tile extent per itemsize, minor is always 128
 _SUBLANE = {8: 4, 4: 8, 2: 16, 1: 32}
+
+
+def supports(dtype) -> bool:
+    """Blend kernels know the tile geometry only for these itemsizes; exotic
+    dtypes (e.g. complex128, itemsize 16) fall back to the DUS path."""
+    return jnp.dtype(dtype).itemsize in _SUBLANE
+
+
+def vma_check(dtypes, valid_last=None, ndim_extra: int = 0) -> bool:
+    """The ``check_vma`` value for a shard_map wrapping the exchange: vma
+    validation stays ON (True) whenever the blend kernels — whose pallas
+    outputs carry no vma annotation — cannot engage for this configuration
+    (mirrors the blend condition in ``halo_exchange_multi``)."""
+    if not enabled() or ndim_extra != 0:
+        return True
+    if not all(supports(dt) for dt in dtypes):
+        return True
+    # blend runs only on y/z axes that divide evenly (valid_last entry None)
+    if valid_last is not None and valid_last[1] is not None and valid_last[2] is not None:
+        return True
+    return False
 
 
 def _sublane(dtype) -> int:

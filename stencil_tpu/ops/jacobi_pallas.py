@@ -174,6 +174,9 @@ def jacobi_slab_step(
     from jax.experimental.pallas import tpu as pltpu
 
     X, Y, Z = block.shape
+    # at X == 1 the i == 1 and i == X branches both fire and the second reads
+    # ring[1], which is never written — shards must carry >= 2 x-planes
+    assert X >= 2, f"jacobi_slab_step requires X >= 2 planes per shard, got {X}"
     gx = global_size[0]
     hot_x, cold_x, in_r2 = sphere_params(gx)
 

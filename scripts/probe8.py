@@ -9,7 +9,6 @@ Checks B bit-exact vs C (self-permuted slabs == periodic wrap).
 
 from __future__ import annotations
 
-import time
 from functools import partial
 
 import jax

@@ -1,0 +1,225 @@
+"""Probe: decompose the wrap kernel's cost on the real chip.
+
+r3 verdict: wrap path = 0.678 of the chip's copy-derived roofline.  Where do
+the other 32% go?  Variants (all same grid/pipeline unless noted):
+
+  base   — production jacobi_wrap_step
+  copy   — out = cur (pipeline/DMA floor at the same X+2 grid)
+  noroll — sum of 5 unshifted cent (VPU adds, no rotates) [wrong numerics]
+  nosph  — rolls but no sphere selects [wrong numerics]
+  predsph— sphere selects predicated on a scalar per-plane range test
+  b2     — 2 planes per grid step (halved grid overhead) [if VMEM fits]
+
+Prints ms/iter and Gcells/s for each; correctness only for base/predsph/b2.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+from stencil_tpu.ops.jacobi_pallas import (
+    HOT_TEMP,
+    COLD_TEMP,
+    jacobi_wrap_step,
+    sphere_params,
+    yz_dist2_plane,
+)
+
+SIZE = 512
+STEPS = 100
+
+
+def variant_step(block, mode: str):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    X, Y, Z = block.shape
+    gx = X
+    hot_x, cold_x, in_r2 = sphere_params(gx)
+
+    def roll(v, amt, axis):
+        return pltpu.roll(v, amt % v.shape[axis], axis)
+
+    def kernel(in_ref, d2_ref, out_ref, ring):
+        i = pl.program_id(0)
+        cur = in_ref[0]
+
+        @pl.when(i >= 2)
+        def _():
+            prev = ring[i % 2]
+            cent = ring[(i + 1) % 2]
+            if mode == "copy":
+                out_ref[0] = cur
+                return
+            if mode == "noroll":
+                val = (prev + cur + cent + cent + cent + cent) / 6.0
+                out_ref[0] = val.astype(cur.dtype)
+                return
+            val = (
+                prev
+                + cur
+                + roll(cent, 1, 0)
+                + roll(cent, -1, 0)
+                + roll(cent, 1, 1)
+                + roll(cent, -1, 1)
+            ) / 6.0
+            x_g = (i - 1) % X
+            if mode == "nosph":
+                out_ref[0] = val.astype(cur.dtype)
+                return
+            if mode == "predsph":
+                hot_r2 = in_r2 - (x_g - hot_x) ** 2
+                cold_r2 = in_r2 - (x_g - cold_x) ** 2
+
+                @pl.when(jnp.logical_or(hot_r2 > 0, cold_r2 > 0))
+                def _():
+                    d2 = d2_ref[...]
+                    v = jnp.where(d2 < hot_r2, HOT_TEMP, val)
+                    v = jnp.where(d2 < cold_r2, COLD_TEMP, v)
+                    out_ref[0] = v.astype(cur.dtype)
+
+                @pl.when(jnp.logical_not(jnp.logical_or(hot_r2 > 0, cold_r2 > 0)))
+                def _():
+                    out_ref[0] = val.astype(cur.dtype)
+
+                return
+            d2 = d2_ref[...]
+            val = jnp.where(d2 < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
+            val = jnp.where(d2 < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
+            out_ref[0] = val.astype(cur.dtype)
+
+        @pl.when(i < 2)
+        def _():
+            out_ref[0] = cur
+
+        ring[i % 2] = cur
+
+    d2 = yz_dist2_plane(0, 0, (Y, Z), block.shape)
+    return pl.pallas_call(
+        kernel,
+        grid=(X + 2,),
+        in_specs=[
+            pl.BlockSpec((1, Y, Z), lambda i: (i % X, 0, 0)),
+            pl.BlockSpec((Y, Z), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Y, Z), lambda i: ((i - 1) % X, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
+        scratch_shapes=[pltpu.VMEM((2, Y, Z), block.dtype)],
+    )(block, d2.astype(jnp.int32))
+
+
+def b2_step(block):
+    """2 planes per grid step: grid nb+2 over plane-pairs; ring holds the two
+    previous BLOCKS so every output plane's 3-plane support is resident."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B = 2
+    X, Y, Z = block.shape
+    nb = X // B
+    gx = X
+    hot_x, cold_x, in_r2 = sphere_params(gx)
+
+    def roll(v, amt, axis):
+        return pltpu.roll(v, amt % v.shape[axis], axis)
+
+    def kernel(in_ref, d2_ref, out_ref, ring):
+        i = pl.program_id(0)
+        cur = in_ref[...]  # (B, Y, Z) block of planes
+
+        @pl.when(i >= 2)
+        def _():
+            prevblk = ring[i % 2]  # block i-2
+            cent = ring[(i + 1) % 2]  # block i-1 -> output block
+            xm1 = jnp.concatenate([prevblk[B - 1 : B], cent[: B - 1]], axis=0)
+            xp1 = jnp.concatenate([cent[1:], cur[0:1]], axis=0)
+            val = (
+                xm1
+                + xp1
+                + roll(cent, 1, 1)
+                + roll(cent, -1, 1)
+                + roll(cent, 1, 2)
+                + roll(cent, -1, 2)
+            ) / 6.0
+            b0 = ((i - 1) % nb) * B
+            d2 = d2_ref[...]
+            for p in range(B):
+                x_g = b0 + p
+                v = jnp.where(d2 < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val[p])
+                v = jnp.where(d2 < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, v)
+                out_ref[p] = v.astype(cur.dtype)
+
+        @pl.when(i < 2)
+        def _():
+            out_ref[...] = cur
+
+        ring[i % 2] = cur
+
+    d2 = yz_dist2_plane(0, 0, (Y, Z), block.shape)
+    return pl.pallas_call(
+        kernel,
+        grid=(nb + 2,),
+        in_specs=[
+            pl.BlockSpec((B, Y, Z), lambda i: (i % nb, 0, 0)),
+            pl.BlockSpec((Y, Z), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, Y, Z), lambda i: ((i - 1) % nb, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
+        scratch_shapes=[pltpu.VMEM((2, B, Y, Z), block.dtype)],
+    )(block, d2.astype(jnp.int32))
+
+
+def main():
+    n = SIZE
+    rt = host_round_trip_s()
+    print(f"host rt: {rt*1e3:.1f} ms")
+    init_np = np.asarray(
+        jax.random.uniform(jax.random.PRNGKey(0), (n, n, n), jnp.float32)
+    )
+    fresh = lambda: jnp.asarray(init_np)
+
+    def time_variant(name, one_step, check_against=None):
+        @partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def loop(b, s):
+            return lax.fori_loop(0, s, lambda _, x: one_step(x), b)
+
+        state = {"a": fresh()}
+
+        def run(k):
+            state["a"] = loop(state["a"], k)
+            float(jnp.sum(state["a"][0, 0, 0:1]))
+
+        samples, _ = timed_inner_loop(run, STEPS, rt, 3)
+        t = min(samples)
+        line = f"{name:8s} {t*1e3:.3f} ms/iter  {n**3/t/1e9:.1f} Gcells/s"
+        if check_against is not None:
+            got = np.asarray(loop(fresh(), STEPS))
+            line += f"  bit-exact={np.array_equal(got, check_against)}"
+        print(line, flush=True)
+        return t
+
+    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def base_loop(b, s):
+        return lax.fori_loop(0, s, lambda _, x: jacobi_wrap_step(x), b)
+
+    ref = np.asarray(base_loop(fresh(), STEPS))
+
+    time_variant("base", jacobi_wrap_step)
+    time_variant("copy", lambda b: variant_step(b, "copy"))
+    time_variant("noroll", lambda b: variant_step(b, "noroll"))
+    time_variant("nosph", lambda b: variant_step(b, "nosph"))
+    time_variant("predsph", lambda b: variant_step(b, "predsph"), check_against=ref)
+    try:
+        time_variant("b2", b2_step, check_against=ref)
+    except Exception as e:
+        print(f"b2 failed: {type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

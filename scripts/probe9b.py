@@ -1,0 +1,160 @@
+"""Probe: does the plane pipeline's per-step overhead shrink with block size?
+
+probe9 showed base == pure-copy == 3.15 ms at 512^3 (514 one-plane grid
+steps): the wrap kernel is pipeline-bound, ~2us/step of overhead on top of
+the 2.1 ms DMA floor.  Here:
+
+  copyB<b>  — pure copy kernel with (b, Y, Z) blocks: pipeline floor vs b
+  jacB<b>   — full jacobi with (b, Y, Z) blocks, PER-PLANE compute (1-plane
+              temporaries keep VMEM under budget); bit-checked vs base
+
+If copyB4 ~= DMA floor, block size is the whole gap.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+from stencil_tpu.ops.jacobi_pallas import (
+    COLD_TEMP,
+    HOT_TEMP,
+    jacobi_wrap_step,
+    sphere_params,
+    yz_dist2_plane,
+)
+
+SIZE = 512
+STEPS = 100
+
+
+def copy_block_step(block, B: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    X, Y, Z = block.shape
+    nb = X // B
+
+    def kernel(in_ref, out_ref):
+        out_ref[...] = in_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((B, Y, Z), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((B, Y, Z), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
+    )(block)
+
+
+def jacobi_block_step(block, B: int):
+    """(B, Y, Z) blocks, ring of 2 blocks, per-plane compute."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    X, Y, Z = block.shape
+    nb = X // B
+    gx = X
+    hot_x, cold_x, in_r2 = sphere_params(gx)
+
+    def roll(v, amt, axis):
+        return pltpu.roll(v, amt % v.shape[axis], axis)
+
+    def kernel(in_ref, d2_ref, out_ref, ring):
+        i = pl.program_id(0)
+        cur = in_ref[...]
+
+        @pl.when(i >= 2)
+        def _():
+            prevblk = ring[i % 2]  # planes of block i-2
+            cent = ring[(i + 1) % 2]  # planes of block i-1 (the output block)
+            b0 = ((i - 1) % nb) * B
+            d2 = d2_ref[...]
+            for p in range(B):
+                pm1 = prevblk[B - 1] if p == 0 else cent[p - 1]
+                pp1 = cur[0] if p == B - 1 else cent[p + 1]
+                c = cent[p]
+                val = (
+                    pm1
+                    + pp1
+                    + roll(c, 1, 0)
+                    + roll(c, -1, 0)
+                    + roll(c, 1, 1)
+                    + roll(c, -1, 1)
+                ) / 6.0
+                x_g = b0 + p
+                val = jnp.where(d2 < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
+                val = jnp.where(d2 < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
+                out_ref[p] = val.astype(block.dtype)
+
+        @pl.when(i < 2)
+        def _():
+            out_ref[...] = cur
+
+        ring[i % 2] = cur
+
+    d2 = yz_dist2_plane(0, 0, (Y, Z), block.shape)
+    return pl.pallas_call(
+        kernel,
+        grid=(nb + 2,),
+        in_specs=[
+            pl.BlockSpec((B, Y, Z), lambda i: (i % nb, 0, 0)),
+            pl.BlockSpec((Y, Z), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, Y, Z), lambda i: ((i - 1) % nb, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
+        scratch_shapes=[pltpu.VMEM((2, B, Y, Z), block.dtype)],
+    )(block, d2.astype(jnp.int32))
+
+
+def main():
+    n = SIZE
+    rt = host_round_trip_s()
+    print(f"host rt: {rt*1e3:.1f} ms", flush=True)
+    init_np = np.asarray(
+        jax.random.uniform(jax.random.PRNGKey(0), (n, n, n), jnp.float32)
+    )
+    fresh = lambda: jnp.asarray(init_np)
+
+    def time_variant(name, one_step, check_against=None):
+        @partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def loop(b, s):
+            return lax.fori_loop(0, s, lambda _, x: one_step(x), b)
+
+        state = {"a": fresh()}
+
+        def run(k):
+            state["a"] = loop(state["a"], k)
+            float(jnp.sum(state["a"][0, 0, 0:1]))
+
+        try:
+            samples, _ = timed_inner_loop(run, STEPS, rt, 3)
+        except Exception as e:
+            print(f"{name:8s} FAILED: {type(e).__name__}: {str(e)[:160]}", flush=True)
+            return
+        t = min(samples)
+        line = f"{name:8s} {t*1e3:.3f} ms/iter  {n**3/t/1e9:.1f} Gcells/s"
+        if check_against is not None:
+            got = np.asarray(loop(fresh(), STEPS))
+            line += f"  bit-exact={np.array_equal(got, check_against)}"
+        print(line, flush=True)
+
+    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def base_loop(b, s):
+        return lax.fori_loop(0, s, lambda _, x: jacobi_wrap_step(x), b)
+
+    ref = np.asarray(base_loop(fresh(), STEPS))
+
+    for B in (1, 2, 4, 8):
+        time_variant(f"copyB{B}", lambda b, B=B: copy_block_step(b, B))
+    for B in (2, 4):
+        time_variant(f"jacB{B}", lambda b, B=B: jacobi_block_step(b, B), check_against=ref)
+
+
+if __name__ == "__main__":
+    main()

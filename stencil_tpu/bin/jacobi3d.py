@@ -41,19 +41,20 @@ def main(argv=None) -> int:
         default="pallas",
         help="pallas plane-streaming kernel (fast) or XLA slices",
     )
+    p.add_argument(
+        "--overlap-report",
+        action="store_true",
+        help="time overlap=True vs overlap=False (jnp kernel) and report the "
+        "achieved-overlap delta (reference --no-overlap A/B, jacobi3d.cu:265-337)",
+    )
     p.add_argument("x", type=int, nargs="?", default=512)
     p.add_argument("y", type=int, nargs="?", default=512)
     p.add_argument("z", type=int, nargs="?", default=512)
     args = p.parse_args(argv)
 
-    num_subdoms = len(jax.devices())
-    if args.no_weak_scale:
-        x, y, z = args.x, args.y, args.z
-    else:
-        # jacobi3d.cu:167-169
-        x = weak_scaled_size(args.x, num_subdoms)
-        y = weak_scaled_size(args.y, num_subdoms)
-        z = weak_scaled_size(args.z, num_subdoms)
+    x, y, z = _global_size(args)
+    if args.overlap_report:
+        return _overlap_report(args, x, y, z)
 
     checkpoint_period = args.period if args.period > 0 else max(args.iters // 10, 1)
 
@@ -111,6 +112,55 @@ def main(argv=None) -> int:
         print(
             f"jacobi3d,{_common.method_str(args)},{ranks},{dev_count},"
             f"{x},{y},{z},{iter_time.min()},{iter_time.trimean()}"
+        )
+    return 0
+
+
+def _global_size(args):
+    """CLI base size -> global size, weak-scaled by numSubdoms^(1/3)
+    (jacobi3d.cu:167-169) unless --no-weak-scale."""
+    if args.no_weak_scale:
+        return args.x, args.y, args.z
+    n = len(jax.devices())
+    return tuple(weak_scaled_size(v, n) for v in (args.x, args.y, args.z))
+
+
+def _overlap_report(args, x, y, z) -> int:
+    """A/B the interior/exterior overlap split on this hardware: identical
+    jnp-kernel models, overlap on vs off, one timing line each plus the
+    ratio.  The scheduled-HLO interleaving itself is pinned by
+    tests/test_overlap_schedule.py; this reports the achieved wall-clock
+    effect (the reference measures the same thing by rerunning with
+    --no-overlap)."""
+    rt = _common.host_round_trip_s()
+    results = {}
+    for overlap in (True, False):
+        model = Jacobi3D(
+            x, y, z,
+            overlap=overlap,
+            strategy=_common.parse_strategy(args),
+            methods=_common.parse_methods(args),
+            kernel_impl="jnp",
+        )
+        model.realize()
+
+        def run(k, model=model):
+            model.step(k)
+            model.block_until_ready()
+
+        samples, _ = _common.timed_inner_loop(run, 10, rt, args.iters)
+        results[overlap] = min(samples)
+    if jax.process_index() == 0:
+        t_on, t_off = results[True], results[False]
+        print(
+            f"overlap-report,{x},{y},{z},{t_on},{t_off},"
+            f"{(t_off - t_on) / t_off if t_off > 0 else 0.0:.4f}"
+        )
+        print(
+            f"# overlap=True {t_on*1e3:.3f} ms/iter; overlap=False "
+            f"{t_off*1e3:.3f} ms/iter; saved {(t_off-t_on)*1e3:.3f} ms "
+            f"({100*(t_off-t_on)/t_off if t_off > 0 else 0:.1f}%)",
+            file=sys.stderr,
         )
     return 0
 

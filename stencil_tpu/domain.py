@@ -212,7 +212,12 @@ class DistributedDomain:
     def enable_exchange_stats(self, on: bool = True) -> None:
         self._exchange_stats = on
 
-    def realize(self) -> None:
+    def realize(self, allocate: bool = True) -> None:
+        """``allocate=False`` sets up mesh/placement/geometry WITHOUT creating
+        arrays or compiling the exchange — for AOT work over device-less
+        topologies (``jax.experimental.topologies``), where ``make_step`` can
+        then be lowered/compiled against abstract sharded shapes (used by the
+        overlap-schedule proof, tests/test_overlap_schedule.py)."""
         self._radius.validate()
         t0 = time.perf_counter()
         devices = list(self._devices) if self._devices is not None else jax.devices()
@@ -259,6 +264,10 @@ class DistributedDomain:
         raw = self._spec.raw_size()
         sharding = NamedSharding(self.mesh, P(*MESH_AXES))
         gshape = (dim.x * raw.x, dim.y * raw.y, dim.z * raw.z)
+        if not allocate:
+            self._realized = True
+            log_info(f"realized (abstract) {self._size} over mesh {dim} (raw shard {raw})")
+            return
         t0 = time.perf_counter()
         for h in self._handles:
             self._curr[h.name] = jnp.zeros(gshape, dtype=h.dtype, device=sharding)
@@ -285,6 +294,18 @@ class DistributedDomain:
             self.stats.time_create = time.perf_counter() - t0
         self._realized = True
         log_info(f"realized {self._size} over mesh {dim} (raw shard {raw})")
+
+    def abstract_arrays(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        """Sharded ShapeDtypeStructs matching the quantity arrays — lowering
+        inputs for AOT compilation (pairs with ``realize(allocate=False)``)."""
+        dim = self.placement.dim()
+        raw = self._spec.raw_size()
+        gshape = (dim.x * raw.x, dim.y * raw.y, dim.z * raw.z)
+        sharding = NamedSharding(self.mesh, P(*MESH_AXES))
+        return {
+            h.name: jax.ShapeDtypeStruct(gshape, h.dtype, sharding=sharding)
+            for h in self._handles
+        }
 
     # --- geometry accessors ---------------------------------------------------
     def local_spec(self) -> LocalSpec:

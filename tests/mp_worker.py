@@ -1,0 +1,85 @@
+"""Worker for the REAL multi-process test tier (test_multiprocess.py).
+
+Run as:  python mp_worker.py <port> <process_id> <num_processes>
+
+Each worker joins a jax.distributed job on CPU with 4 fake local devices, so
+2 workers form the 8-device fleet the single-process tests fake — but with a
+true process boundary: ``distributed.initialize``, ``barrier``,
+``broadcast_from_host0``, ``allgather_hosts``, and the process-split
+NodePartition all execute their multi-host code paths (reference analog: the
+2-rank MPI test binary, test/CMakeLists.txt:34-45, test_cuda_mpi_exchange.cu).
+"""
+
+import os
+import sys
+
+port, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from stencil_tpu.core.radius import Radius  # noqa: E402
+from stencil_tpu.domain import DistributedDomain  # noqa: E402
+from stencil_tpu.parallel import distributed  # noqa: E402
+
+
+def main() -> None:
+    distributed.initialize(f"localhost:{port}", nproc, pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == 4 * nproc
+
+    # --- host coordination (MPI_Barrier / Bcast / Allgather analogs) --------
+    distributed.barrier("mp_start")
+    seed = distributed.broadcast_from_host0(
+        np.int64(1234) if pid == 0 else np.int64(0)
+    )
+    assert int(seed) == 1234, seed
+    ag = distributed.allgather_hosts(np.array([pid], np.int32))
+    assert ag.shape == (nproc, 1), ag.shape
+    assert list(ag[:, 0]) == list(range(nproc)), ag
+
+    # --- ripple exchange over the process-split NodePartition ---------------
+    g = 16
+    dd = DistributedDomain(g, g, g)
+    dd.set_radius(Radius.constant(2))
+    h = dd.add_data("q", dtype=jnp.float32)
+    dd.realize()
+    assert dd.num_subdomains() == 4 * nproc
+    dd.init_by_coords(
+        h, lambda x, y, z: (x * 10000 + y * 100 + z).astype(jnp.float32)
+    )
+    dd.exchange()
+
+    # every ADDRESSABLE shard's full raw block (interior + 26-direction halo
+    # shell) must equal the wrapped analytic field — any wrong halo byte from
+    # a cross-process ppermute shows up here
+    arr = dd.get_curr(h)
+    raw = dd.local_spec().raw_size()
+    n = dd.local_spec().sz
+    lo = dd._shell_radius.lo()
+    checked = 0
+    for shard in arr.addressable_shards:
+        coords = [shard.index[a].start // raw[a] for a in range(3)]
+        ax = [
+            (coords[a] * n[a] - lo[a] + np.arange(raw[a])) % g for a in range(3)
+        ]
+        expect = (
+            ax[0][:, None, None] * 10000 + ax[1][None, :, None] * 100 + ax[2][None, None, :]
+        ).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(shard.data), expect)
+        checked += 1
+    assert checked == 4, checked
+
+    distributed.barrier("mp_done")
+    print(f"MP_OK {pid} shards={checked}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Probe: is the pallas pipeline's deficit fixed-cost or proportional?
+
+probe9b: pallas block-copy = 329 GB/s effective while the XLA x+1 loop on the
+same chip = ~508 GB/s, independent of block size (B=1,2,4 identical).  Two
+hypotheses:
+  H1 fixed per-pallas_call cost (~1.1 ms) -> at 256^3 the copy time stays
+     ~const instead of dropping 8x.
+  H2 proportional (pallas DMA sustains ~2/3 of streaming bandwidth) ->
+     time scales with size.
+Also times the wrap kernel at 256^3/384^3 to see how the production number
+scales, and an emit-style multi-buffered variant knob if cheap.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+from stencil_tpu.ops.jacobi_pallas import jacobi_wrap_step
+
+STEPS = 100
+
+
+def copy_block_step(block, B: int):
+    from jax.experimental import pallas as pl
+
+    X, Y, Z = block.shape
+    nb = X // B
+
+    def kernel(in_ref, out_ref):
+        out_ref[...] = in_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((B, Y, Z), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((B, Y, Z), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
+    )(block)
+
+
+def main():
+    rt = host_round_trip_s()
+    print(f"host rt: {rt*1e3:.1f} ms", flush=True)
+
+    def time_fn(name, one_step, n):
+        @partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def loop(b, s):
+            return lax.fori_loop(0, s, lambda _, x: one_step(x), b)
+
+        state = {"a": jnp.ones((n, n, n), jnp.float32)}
+
+        def run(k):
+            state["a"] = loop(state["a"], k)
+            float(jnp.sum(state["a"][0, 0, 0:1]))
+
+        try:
+            samples, _ = timed_inner_loop(run, STEPS, rt, 3)
+        except Exception as e:
+            print(f"{name:12s} FAILED: {type(e).__name__}: {str(e)[:140]}", flush=True)
+            return
+        t = min(samples)
+        gbps = 2 * n**3 * 4 / t / 1e9
+        print(f"{name:12s} {t*1e3:.3f} ms/iter  {gbps:.0f} GB/s r+w", flush=True)
+
+    for n in (512, 384, 256):
+        time_fn(f"xla+1 {n}", lambda b: b + 1.0, n)
+    for n in (512, 384, 256):
+        time_fn(f"palcopy {n}", lambda b: copy_block_step(b, 4), n)
+    for n in (512, 384, 256):
+        time_fn(f"wrap {n}", jacobi_wrap_step, n)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Probe: locate the pallas-copy bandwidth cliff between 256^3 and 384^3.
+
+probe9c: palcopy(256^3)=745 GB/s but palcopy(384^3)=345, palcopy(512^3)=347,
+with block size irrelevant (B=1 vs B=4 identical at 512).  Separate the
+variables: total size, plane shape, X length, and VMEM headroom.
+
+Also re-times xla+1 at 514^3 (ragged tiles) to explain bench.py's low 508
+GB/s chip-copy number.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+
+STEPS = 100
+
+
+def copy_block_step(block, B: int, vmem_mb=None):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    X, Y, Z = block.shape
+    nb = X // B
+
+    def kernel(in_ref, out_ref):
+        out_ref[...] = in_ref[...]
+
+    kw = {}
+    if vmem_mb is not None:
+        kw["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_mb * 1024 * 1024
+        )
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((B, Y, Z), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((B, Y, Z), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
+        **kw,
+    )(block)
+
+
+def main():
+    rt = host_round_trip_s()
+    print(f"host rt: {rt*1e3:.1f} ms", flush=True)
+
+    def time_fn(name, one_step, shape):
+        @partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def loop(b, s):
+            return lax.fori_loop(0, s, lambda _, x: one_step(x), b)
+
+        state = {"a": jnp.ones(shape, jnp.float32)}
+
+        def run(k):
+            state["a"] = loop(state["a"], k)
+            float(jnp.sum(state["a"][(slice(0, 1),) * len(shape)]))
+
+        try:
+            samples, _ = timed_inner_loop(run, STEPS, rt, 3)
+        except Exception as e:
+            print(f"{name:22s} FAILED: {type(e).__name__}: {str(e)[:120]}", flush=True)
+            return
+        t = min(samples)
+        cells = int(np.prod(shape))
+        print(f"{name:22s} {t*1e3:.3f} ms/iter  {2*cells*4/t/1e9:.0f} GB/s r+w", flush=True)
+
+    # the cliff in total size at fixed-ish plane shapes
+    for n in (256, 288, 320, 352, 384):
+        time_fn(f"palcopy {n}^3", lambda b: copy_block_step(b, 4), (n, n, n))
+    # plane shape vs X length vs total size
+    time_fn("palcopy 512x256x256", lambda b: copy_block_step(b, 4), (512, 256, 256))
+    time_fn("palcopy 1024x256x256", lambda b: copy_block_step(b, 4), (1024, 256, 256))
+    time_fn("palcopy 2048x256x256", lambda b: copy_block_step(b, 4), (2048, 256, 256))
+    time_fn("palcopy 256x512x512", lambda b: copy_block_step(b, 4), (256, 512, 512))
+    time_fn("palcopy 128x512x512", lambda b: copy_block_step(b, 4), (128, 512, 512))
+    # VMEM limit knob at 512^3
+    time_fn("palcopy 512^3 vm32", lambda b: copy_block_step(b, 4, vmem_mb=32), (512, 512, 512))
+    time_fn("palcopy 512^3 vm64", lambda b: copy_block_step(b, 4, vmem_mb=64), (512, 512, 512))
+    # ragged-tile xla copy (bench.py's old measurement)
+    time_fn("xla+1 514^3", lambda b: b + 1.0, (514, 514, 514))
+    time_fn("xla+1 512^3", lambda b: b + 1.0, (512, 512, 512))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""Probe: can manual DMA pipelining recover the 2x the auto-pipeline loses?
+
+probe9d: pallas auto-pipelined copies plateau at ~350 GB/s r+w on big arrays
+while XLA fusions stream 720 — consistent with the per-step in/out DMAs
+serializing.  Variants:
+
+  par      — auto pipeline + dimension_semantics=('parallel',)
+  hbm2hbm  — ONE direct HBM->HBM async copy (DMA engine ceiling, no VMEM)
+  manual<N>— manual pipeline: N revolving VMEM slots, in-DMA and out-DMA of
+             different chunks in flight simultaneously
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+
+STEPS = 100
+N = 512
+
+
+def copy_parallel(block, B=4):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    X, Y, Z = block.shape
+    nb = X // B
+
+    def kernel(in_ref, out_ref):
+        out_ref[...] = in_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((B, Y, Z), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((B, Y, Z), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        ),
+    )(block)
+
+
+def copy_hbm2hbm(block):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(in_hbm, out_hbm):
+        def body(sem):
+            dma = pltpu.make_async_copy(in_hbm, out_hbm, sem)
+            dma.start()
+            dma.wait()
+
+        pl.run_scoped(body, sem=pltpu.SemaphoreType.DMA)
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct(block.shape, block.dtype),
+    )(block)
+
+
+def copy_manual(block, chunk=4, nbuf=4):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    X, Y, Z = block.shape
+    nch = X // chunk
+
+    def kernel(in_hbm, out_hbm):
+        def body(scratch, insem, outsem):
+            def in_dma(slot, ci):
+                return pltpu.make_async_copy(
+                    in_hbm.at[pl.ds(ci * chunk, chunk)],
+                    scratch.at[slot],
+                    insem.at[slot],
+                )
+
+            def out_dma(slot, ci):
+                return pltpu.make_async_copy(
+                    scratch.at[slot],
+                    out_hbm.at[pl.ds(ci * chunk, chunk)],
+                    outsem.at[slot],
+                )
+
+            for k in range(min(nbuf, nch)):
+                in_dma(k, k).start()
+
+            def loop(ci, _):
+                slot = ci % nbuf
+                in_dma(slot, ci).wait()
+                out_dma(slot, ci).start()
+                nxt = ci + nbuf
+
+                @pl.when(nxt < nch)
+                def _():
+                    out_dma(slot, ci).wait()  # slot drained
+                    in_dma(slot, nxt).start()
+
+                return 0
+
+            lax.fori_loop(0, nch, loop, 0)
+            # drain the tail: the last min(nbuf, nch) out-DMAs
+            for k in range(min(nbuf, nch)):
+                ci = nch - min(nbuf, nch) + k
+                out_dma(ci % nbuf, ci).wait()
+
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((nbuf, chunk, Y, Z), block.dtype),
+            insem=pltpu.SemaphoreType.DMA((nbuf,)),
+            outsem=pltpu.SemaphoreType.DMA((nbuf,)),
+        )
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct(block.shape, block.dtype),
+    )(block)
+
+
+def main():
+    rt = host_round_trip_s()
+    print(f"host rt: {rt*1e3:.1f} ms", flush=True)
+
+    def time_fn(name, one_step, check=False):
+        @partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def loop(b, s):
+            return lax.fori_loop(0, s, lambda _, x: one_step(x), b)
+
+        state = {"a": jnp.ones((N, N, N), jnp.float32)}
+
+        def run(k):
+            state["a"] = loop(state["a"], k)
+            float(jnp.sum(state["a"][0, 0, 0:1]))
+
+        try:
+            if check:
+                x = jnp.asarray(
+                    np.arange(N * 4, dtype=np.float32).reshape(4, N, 1)
+                    * np.ones((4, N, N), np.float32)
+                )
+                x = jnp.ones((N, N, N), jnp.float32).at[:4].set(x)
+                got = one_step(x)
+                ok = bool(jnp.array_equal(got, x))
+            samples, _ = timed_inner_loop(run, STEPS, rt, 3)
+        except Exception as e:
+            print(f"{name:10s} FAILED: {type(e).__name__}: {str(e)[:160]}", flush=True)
+            return
+        t = min(samples)
+        line = f"{name:10s} {t*1e3:.3f} ms/iter  {2*N**3*4/t/1e9:.0f} GB/s r+w"
+        if check:
+            line += f"  copy-correct={ok}"
+        print(line, flush=True)
+
+    time_fn("par", copy_parallel)
+    time_fn("hbm2hbm", copy_hbm2hbm, check=True)
+    for nbuf in (3, 4, 8):
+        time_fn(f"manual{nbuf}", lambda b, nb=nbuf: copy_manual(b, 4, nb), check=True)
+
+
+if __name__ == "__main__":
+    main()

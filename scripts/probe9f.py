@@ -1,0 +1,178 @@
+"""Probe: do concurrent DMAs over DISJOINT buffers scale past ~350 GB/s?
+
+probe9e: one whole-array HBM->HBM DMA = 343 GB/s r+w; manual multi-slot
+pipelines on the same buffer pair = the same.  If DMA queues are per
+buffer-pair, concurrent DMAs on separate arrays should add up.  Variants:
+
+  dma1/dma2/dma4 — k disjoint (512/k,512,512) array pairs copied by k
+                   concurrent DMAs inside one pallas call
+  vecload        — HBM->VMEM one-way DMA only (no writeback): one-way rate
+  xla2           — two arrays through one jitted (a+1, b+1) (vector-core ref)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+
+STEPS = 100
+N = 512
+
+
+def copy_k(arrays):
+    """k concurrent whole-array HBM->HBM DMAs, k = len(arrays)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k = len(arrays)
+
+    def kernel(*refs):
+        ins, outs = refs[:k], refs[k:]
+
+        def body(sems):
+            dmas = [
+                pltpu.make_async_copy(ins[j], outs[j], sems.at[j])
+                for j in range(k)
+            ]
+            for d in dmas:
+                d.start()
+            for d in dmas:
+                d.wait()
+
+        pl.run_scoped(body, sems=pltpu.SemaphoreType.DMA((k,)))
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * k,
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY) for _ in range(k)),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays
+        ),
+    )(*arrays)
+
+
+def vecload(block, chunk=8):
+    """HBM->VMEM in-DMAs only (revolving 2 slots), tiny VMEM writeback."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    X, Y, Z = block.shape
+    nch = X // chunk
+
+    def kernel(in_hbm, out_ref):
+        def body(scratch, sems):
+            def dma(slot, ci):
+                return pltpu.make_async_copy(
+                    in_hbm.at[pl.ds(ci * chunk, chunk)],
+                    scratch.at[slot],
+                    sems.at[slot],
+                )
+
+            dma(0, 0).start()
+
+            def loop(ci, acc):
+                slot = ci % 2
+
+                @pl.when(ci + 1 < nch)
+                def _():
+                    dma((ci + 1) % 2, ci + 1).start()
+
+                dma(slot, ci).wait()
+                return acc + scratch[slot, 0, 0, 0]
+
+            acc = lax.fori_loop(0, nch, loop, jnp.float32(0))
+            out_ref[0] = acc
+
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((2, chunk, Y, Z), block.dtype),
+            sems=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1,), block.dtype),
+    )(block)
+
+
+def main():
+    rt = host_round_trip_s()
+    print(f"host rt: {rt*1e3:.1f} ms", flush=True)
+
+    def time_k(k):
+        parts = [jnp.ones((N // k, N, N), jnp.float32) for _ in range(k)]
+
+        @partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def loop(arrs, s):
+            def body(_, a):
+                return copy_k(a)
+
+            return lax.fori_loop(0, s, body, tuple(arrs))
+
+        state = {"a": tuple(parts)}
+
+        def run(kk):
+            state["a"] = loop(state["a"], kk)
+            float(jnp.sum(state["a"][0][0, 0, 0:1]))
+
+        try:
+            samples, _ = timed_inner_loop(run, STEPS, rt, 3)
+        except Exception as e:
+            print(f"dma{k}     FAILED: {type(e).__name__}: {str(e)[:160]}", flush=True)
+            return
+        t = min(samples)
+        print(f"dma{k}      {t*1e3:.3f} ms/iter  {2*N**3*4/t/1e9:.0f} GB/s r+w", flush=True)
+
+    for k in (1, 2, 4):
+        time_k(k)
+
+    # one-way in-DMA rate
+    @partial(jax.jit, donate_argnums=0)
+    def vl(b):
+        return vecload(b)
+
+    b = jnp.ones((N, N, N), jnp.float32)
+    s = {"n": 0}
+
+    def runv(k):
+        out = None
+        for _ in range(k):
+            out = vl(b)
+        float(out[0])
+
+    try:
+        samples, _ = timed_inner_loop(runv, 20, rt, 3)
+        t = min(samples)
+        print(f"vecload   {t*1e3:.3f} ms/iter  {N**3*4/t/1e9:.0f} GB/s one-way", flush=True)
+    except Exception as e:
+        print(f"vecload FAILED: {type(e).__name__}: {str(e)[:160]}", flush=True)
+
+    # xla reference on two arrays
+    a1 = jnp.ones((N // 2, N, N), jnp.float32)
+    a2 = jnp.ones((N // 2, N, N), jnp.float32)
+
+    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def xla2(arrs, s):
+        return lax.fori_loop(0, s, lambda _, t: (t[0] + 1.0, t[1] + 1.0), tuple(arrs))
+
+    st = {"a": (a1, a2)}
+
+    def runx(k):
+        st["a"] = xla2(st["a"], k)
+        float(jnp.sum(st["a"][0][0, 0, 0:1]))
+
+    samples, _ = timed_inner_loop(runx, STEPS, rt, 3)
+    t = min(samples)
+    print(f"xla2      {t*1e3:.3f} ms/iter  {2*N**3*4/t/1e9:.0f} GB/s r+w", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Probe: can an XLA conv formulation beat the DMA-capped pallas kernel?
+
+probe9f: this chip's DMA fabric tops out at ~320-350 GB/s r+w no matter how
+many queues/buffers, while XLA vector-core fusions stream ~670-720.  A pallas
+plane pipeline therefore CANNOT exceed ~44 Gcells/s at f32 — but XLA's conv
+emitter runs on the vector-core path with internal window reuse.  Time the
+7-point stencil as one (3,3,3) single-channel conv (zero-pad SAME; boundary
+values wrong — PERF ONLY) vs the wrap kernel, plus the 6-roll XLA fusion as
+the known-bad baseline.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+from stencil_tpu.ops.jacobi_pallas import jacobi_wrap_step
+
+STEPS = 50
+N = 512
+
+KERNEL = np.zeros((3, 3, 3), np.float32)
+for d in ((0, 1, 1), (2, 1, 1), (1, 0, 1), (1, 2, 1), (1, 1, 0), (1, 1, 2)):
+    KERNEL[d] = 1.0 / 6.0
+
+
+def conv_step(b):
+    k = jnp.asarray(KERNEL)[None, None]  # OIDHW
+    out = lax.conv_general_dilated(
+        b[None, None],  # NCDHW
+        k,
+        window_strides=(1, 1, 1),
+        padding="SAME",
+    )
+    return out[0, 0]
+
+
+def roll_step(b):
+    out = (
+        jnp.roll(b, 1, 0)
+        + jnp.roll(b, -1, 0)
+        + jnp.roll(b, 1, 1)
+        + jnp.roll(b, -1, 1)
+        + jnp.roll(b, 1, 2)
+        + jnp.roll(b, -1, 2)
+    ) / 6.0
+    return out
+
+
+def main():
+    rt = host_round_trip_s()
+    print(f"host rt: {rt*1e3:.1f} ms", flush=True)
+
+    def time_fn(name, one_step):
+        @partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def loop(b, s):
+            return lax.fori_loop(0, s, lambda _, x: one_step(x), b)
+
+        state = {"a": jnp.ones((N, N, N), jnp.float32)}
+
+        def run(k):
+            state["a"] = loop(state["a"], k)
+            float(jnp.sum(state["a"][0, 0, 0:1]))
+
+        try:
+            samples, _ = timed_inner_loop(run, STEPS, rt, 3)
+        except Exception as e:
+            print(f"{name:10s} FAILED: {type(e).__name__}: {str(e)[:160]}", flush=True)
+            return
+        t = min(samples)
+        print(
+            f"{name:10s} {t*1e3:.3f} ms/iter  {N**3/t/1e9:.1f} Gcells/s",
+            flush=True,
+        )
+
+    time_fn("wrap", jacobi_wrap_step)
+    time_fn("conv", conv_step)
+    time_fn("roll", roll_step)
+    # bf16 wrap: halves DMA bytes — the ceiling doubles if precision allows
+    def wrap16(b):
+        return jacobi_wrap_step(b)
+
+    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def loop16(b, s):
+        return lax.fori_loop(0, s, lambda _, x: jacobi_wrap_step(x), b)
+
+    state = {"a": jnp.ones((N, N, N), jnp.bfloat16)}
+
+    def run16(k):
+        state["a"] = loop16(state["a"], k)
+        float(jnp.sum(state["a"][0, 0, 0:1].astype(jnp.float32)))
+
+    try:
+        samples, _ = timed_inner_loop(run16, STEPS, rt, 3)
+        t = min(samples)
+        print(f"wrap-bf16  {t*1e3:.3f} ms/iter  {N**3/t/1e9:.1f} Gcells/s", flush=True)
+    except Exception as e:
+        print(f"wrap-bf16 FAILED: {type(e).__name__}: {str(e)[:160]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -160,6 +160,94 @@ def make_edge_transfer(mesh, n_dev: int, src: int, dst: int, n_elems: int):
     return go, x
 
 
+def _dst_unique_rounds(pairs):
+    """Split (src, dst, nbytes) pairs into minimal groups where each source
+    and each destination appears at most once — ``lax.ppermute`` requires
+    unique sources and destinations per collective.  All groups still launch
+    in ONE dispatch."""
+    rounds = []
+    for p in pairs:
+        for r in rounds:
+            if all(q[1] != p[1] and q[0] != p[0] for q in r):
+                r.append(p)
+                break
+        else:
+            rounds.append([p])
+    return rounds
+
+
+def make_matrix_transfer(mesh, comm):
+    """Jitted CONTENDED traversal of a bytes matrix: every pair's transfer is
+    in flight in one dispatch, so the fabric sees all copies at once — the
+    TPU expression of the reference's batch-started concurrent copies
+    (bench_alltoallv.cu:139-168 all-pairs streams, measure_buf_exchange.cu:
+    120-159 latch-kernel batch start).  Pairs are grouped by payload size
+    (one input buffer per size class, shared by its collectives) and by
+    unique-destination rounds (a ppermute constraint); XLA's async collective
+    scheduling overlaps the lot.  Returns (go, bufs): ``go(*bufs)`` runs one
+    traversal; time it with block_until_ready."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = comm.shape[0]
+    pairs = [
+        (i, j, int(comm[i, j]))
+        for i in range(n_dev)
+        for j in range(n_dev)
+        if i != j and comm[i, j] > 0
+    ]
+    if not pairs:
+        return None, ()
+    sizes = sorted({sz for _, _, sz in pairs})
+    rounds_by_size = {
+        sz: _dst_unique_rounds([p for p in pairs if p[2] == sz]) for sz in sizes
+    }
+    sharding = NamedSharding(mesh, P("d"))
+    bufs = tuple(
+        jax.device_put(
+            jnp.ones((max(sz // 4, 1) * n_dev,), jnp.float32), sharding
+        )
+        for sz in sizes
+    )
+
+    @jax.jit
+    def go(*arrs):
+        def f(*blks):
+            outs = []
+            for blk, sz in zip(blks, sizes):
+                for rnd in rounds_by_size[sz]:
+                    outs.append(
+                        lax.ppermute(blk, "d", [(i, j) for i, j, _ in rnd])
+                    )
+            return tuple(outs)
+
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=tuple(P("d") for _ in arrs),
+            out_specs=tuple(
+                P("d") for sz in sizes for _ in rounds_by_size[sz]
+            ),
+        )(*arrs)
+
+    return go, bufs
+
+
+def measure_matrix_concurrent(mesh, comm, n_iters: int) -> float:
+    """Seconds for one CONTENDED traversal of the bytes matrix (all pairs in
+    flight together; see make_matrix_transfer).  Compile excluded."""
+    go, bufs = make_matrix_transfer(mesh, comm)
+    if go is None:
+        return 0.0
+    jax.block_until_ready(go(*bufs))
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = go(*bufs)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n_iters
+
+
 def measure_edge(mesh, n_dev: int, src: int, dst: int, nbytes: int, n_iters: int) -> float:
     """Seconds per single-edge transfer of ``nbytes`` (compile excluded)."""
     import time

@@ -40,6 +40,9 @@ def bench(n_iters: int, n_quants: int, ext, radius: Radius, inner: int = 1, rt: 
         dd.add_data(f"d{i}", dtype=jnp.float32)
     dd.realize()
     stats = Statistics()
+    from stencil_tpu.core.geometry import sweep_bytes
+
+    swept = sweep_bytes(dd.local_spec(), [jnp.dtype(jnp.float32).itemsize] * n_quants) * dd.num_subdomains()
     if inner > 1:
         def run(k):
             dd.exchange_many(k)
@@ -49,7 +52,7 @@ def bench(n_iters: int, n_quants: int, ext, radius: Radius, inner: int = 1, rt: 
         samples, _ = _common.timed_inner_loop(run, inner, rt, n_iters)
         for s in samples:
             stats.insert(s)
-        return stats, dd.exchange_bytes_total()
+        return stats, dd.exchange_bytes_total(), swept
     dd.exchange()  # compile
     dd.swap()
     dd.block_until_ready()
@@ -59,19 +62,23 @@ def bench(n_iters: int, n_quants: int, ext, radius: Radius, inner: int = 1, rt: 
         dd.swap()
         dd.block_until_ready()
         stats.insert(time.perf_counter() - t0)
-    return stats, dd.exchange_bytes_total()
+    return stats, dd.exchange_bytes_total(), swept
 
 
 def report_header() -> str:
-    return "name,count,trimean (S),trimean (B/s),stddev,min,avg,max"
+    # reference columns (bench_exchange.cu:57-64) + one honesty column: the
+    # 3-axis sweeps send full-extent slabs, so actual wire bytes exceed the
+    # 26-message model for sparse radii (core/geometry.py sweep_bytes)
+    return "name,count,trimean (S),trimean (B/s),stddev,min,avg,max,trimean (B/s swept)"
 
 
-def report(cfg: str, bytes_: int, stats: Statistics) -> str:
+def report(cfg: str, bytes_: int, stats: Statistics, swept: int = 0) -> str:
     tm = stats.trimean()
     bps = bytes_ / tm if tm else float("nan")
+    sps = swept / tm if tm else float("nan")
     return (
         f"{cfg},{stats.count()},{tm:e},{bps:e},"
-        f"{stats.stddev():e},{stats.min():e},{stats.avg():e},{stats.max():e}"
+        f"{stats.stddev():e},{stats.min():e},{stats.avg():e},{stats.max():e},{sps:e}"
     )
 
 
@@ -125,9 +132,9 @@ def main(argv=None) -> int:
     if jax.process_index() == 0:
         print(report_header())
     for name, radius in sweep_configs(ext, args.fR, args.eR):
-        stats, bytes_ = bench(args.iters, args.quantities, ext, radius, args.inner, rt)
+        stats, bytes_, swept = bench(args.iters, args.quantities, ext, radius, args.inner, rt)
         if jax.process_index() == 0:
-            print(report(name, bytes_, stats))
+            print(report(name, bytes_, stats, swept))
     return 0
 
 

@@ -133,8 +133,10 @@ def _overlap_report(args, x, y, z) -> int:
     effect (the reference measures the same thing by rerunning with
     --no-overlap)."""
     rt = _common.host_round_trip_s()
-    results = {}
-    for overlap in (True, False):
+
+    def measure(overlap):
+        # scoped so the first model's HBM is freed before the second
+        # realize() allocates (the A/B must fit where a single run fits)
         model = Jacobi3D(
             x, y, z,
             overlap=overlap,
@@ -144,12 +146,14 @@ def _overlap_report(args, x, y, z) -> int:
         )
         model.realize()
 
-        def run(k, model=model):
+        def run(k):
             model.step(k)
             model.block_until_ready()
 
         samples, _ = _common.timed_inner_loop(run, 10, rt, args.iters)
-        results[overlap] = min(samples)
+        return min(samples)
+
+    results = {overlap: measure(overlap) for overlap in (True, False)}
     if jax.process_index() == 0:
         t_on, t_off = results[True], results[False]
         print(

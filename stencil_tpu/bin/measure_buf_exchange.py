@@ -18,7 +18,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from stencil_tpu.bin._common import measure_edge
+from stencil_tpu.bin._common import measure_edge, measure_matrix_concurrent
 
 MiB = 1024 * 1024
 
@@ -65,6 +65,14 @@ def main(argv=None) -> int:
         print_mat("x", x / MiB, lambda v: f"{v:.2f}")
         print_mat("y", y, lambda v: f"{v:.4e}")
         print_mat("dx", dx, lambda v: f"{int(v)}")
+        # contended traversal at the current sizes: all pairs in flight in one
+        # dispatch (the reference's latch-kernel batch start equalizes exactly
+        # these concurrent copies, measure_buf_exchange.cu:120-159; TPU has no
+        # per-collective event timers, so the per-pair y stays sequential and
+        # the contention shows up in this total)
+        print(
+            f"y_concurrent {measure_matrix_concurrent(mesh, x.astype(np.int64), args.sub_iters):.4e}"
+        )
         converged = np.all(np.abs(y[active] - args.target) <= args.tol * args.target)
         if converged:
             break

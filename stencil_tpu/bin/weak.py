@@ -47,12 +47,14 @@ def run(x: int, y: int, z: int, n_iters: int, args, name: str = "weak") -> str:
     num_gpus = ranks * dev_count
     num_nodes = ranks
     s = dd.stats
+    # Colocated/Peer/Direct byte columns are literal 0: those transports do
+    # not exist on TPU — every byte rides the collective and is reported in
+    # the MPI(B) column (the reference sums per-method counters,
+    # src/stencil.cu:260-361)
     row = (
         f"{name},{_common.method_str(args)},{x},{y},{z},{x * y * z},"
         f"{dd.exchange_bytes_for_method(MethodFlags.CudaMpi)},"
-        f"{dd.exchange_bytes_for_method(MethodFlags.AllGather)},"
-        f"{dd.exchange_bytes_for_method(MethodFlags.AllGather)},"
-        f"{dd.exchange_bytes_for_method(MethodFlags.AllGather)},"
+        f"0,0,0,"
         f"{n_iters},{num_gpus},{num_nodes},{ranks},"
         f"{s.time_topo:e},{0.0:e},{0.0:e},{s.time_placement:e},"
         f"{s.time_realize:e},{s.time_plan:e},{s.time_create:e},"

@@ -197,6 +197,27 @@ def exchange_bytes(spec: LocalSpec, itemsizes) -> int:
     return total
 
 
+def sweep_bytes(spec: LocalSpec, itemsizes) -> int:
+    """Bytes one subdomain actually RECEIVES per 3-axis-sweep exchange
+    (ops/exchange.py): each axis's slabs span the FULL raw extent of the
+    other axes — including their halos — so edge/corner data rides along
+    (and transits once per participating axis).  Whenever more than one axis
+    has a radius this exceeds ``exchange_bytes`` (the reference's 26-message
+    model, which counts each edge/corner once): the honest denominator for
+    sweep-based B/s.
+    """
+    raw = spec.raw_size()
+    r = spec.radius
+    total = 0
+    itemsize_sum = sum(int(s) for s in itemsizes)
+    for axis in range(3):
+        others = [raw[b] for b in range(3) if b != axis]
+        plane = others[0] * others[1]
+        # the +axis message has the receiver's -axis halo width and vice versa
+        total += itemsize_sum * plane * (r.axis(axis, -1) + r.axis(axis, +1))
+    return total
+
+
 def ripple_value(p: Dim3) -> float:
     """The analytic test field from the reference's exchange tests
     (test_exchange.cu:14-38): ``x + ripple[x%4] + y + ripple[y%4] + z +

@@ -274,14 +274,22 @@ class DistributedDomain:
             self._next[h.name] = jnp.zeros(gshape, dtype=h.dtype, device=sharding)
         self.stats.time_realize = time.perf_counter() - t0
         t0 = time.perf_counter()
-        if self._methods == MethodFlags.AllGather:
-            # debug method: validates the ppermute path (stencil.hpp:29-41
-            # method selection); even (unpadded) sizes only
-            from stencil_tpu.ops.exchange import make_exchange_fn_allgather
+        if self._methods in (MethodFlags.AllGather, MethodFlags.RollCompare):
+            # debug methods: two independent oracles for the ppermute path
+            # (stencil.hpp:29-41 method selection); even (unpadded) sizes only
+            from stencil_tpu.ops.exchange import (
+                make_exchange_fn_allgather,
+                make_exchange_fn_rollcompare,
+            )
 
             if any(v is not None for v in self._valid_last):
-                raise ValueError("AllGather debug exchange requires even sizes")
-            self._exchange_fn = make_exchange_fn_allgather(self.mesh, r, self._spec, dim)
+                raise ValueError("debug exchange methods require even sizes")
+            maker = (
+                make_exchange_fn_allgather
+                if self._methods == MethodFlags.AllGather
+                else make_exchange_fn_rollcompare
+            )
+            self._exchange_fn = maker(self.mesh, r, self._spec, dim)
         else:
             self._exchange_fn = make_exchange_fn(self.mesh, r, valid_last=self._valid_last)
         self.stats.time_plan = time.perf_counter() - t0
@@ -396,6 +404,50 @@ class DistributedDomain:
         reference quantity_to_host, local_domain.cuh:329-346)."""
         arr = (self._curr if slot == "curr" else self._next)[h.name]
         return self._from_raw_global(np.asarray(jax.device_get(arr)))
+
+    def region_to_host(self, h: DataHandle, region: Rect3, slot: str = "curr") -> np.ndarray:
+        """Arbitrary-region readback in USER-domain (global) coordinates —
+        the reference's ``LocalDomain::region_to_host``
+        (src/local_domain.cu:97, local_domain.cuh:329-346) lifted to the
+        distributed domain.  Gathers only the shards the region touches."""
+        assert self._realized
+        r = Rect3(Dim3.of(region.lo), Dim3.of(region.hi))
+        assert r.lo.all_gt(-1) and (self._size - r.hi).all_gt(-1), (r, self._size)
+        dim = self.placement.dim()
+        n = self._spec.sz
+        raw = self._spec.raw_size()
+        lo = self._shell_radius.lo()
+        arr = (self._curr if slot == "curr" else self._next)[h.name]
+        ext = r.extent()
+        out = np.zeros((ext.x, ext.y, ext.z), dtype=h.dtype)
+        shard_lo = Dim3(*(r.lo[a] // n[a] for a in range(3)))
+        shard_hi = Dim3(*((r.hi[a] - 1) // n[a] if r.hi[a] > r.lo[a] else shard_lo[a] for a in range(3)))
+        for ix in range(shard_lo.x, min(shard_hi.x, dim.x - 1) + 1):
+            for iy in range(shard_lo.y, min(shard_hi.y, dim.y - 1) + 1):
+                for iz in range(shard_lo.z, min(shard_hi.z, dim.z - 1) + 1):
+                    idx = Dim3(ix, iy, iz)
+                    v = self.shard_valid(idx)
+                    # overlap of the request with this shard's valid interior
+                    olo = Dim3(*(max(r.lo[a], idx[a] * n[a]) for a in range(3)))
+                    ohi = Dim3(*(min(r.hi[a], idx[a] * n[a] + v[a]) for a in range(3)))
+                    if not (ohi - olo).all_gt(0):
+                        continue
+                    block = arr[
+                        ix * raw.x + lo.x + olo.x - ix * n.x : ix * raw.x + lo.x + ohi.x - ix * n.x,
+                        iy * raw.y + lo.y + olo.y - iy * n.y : iy * raw.y + lo.y + ohi.y - iy * n.y,
+                        iz * raw.z + lo.z + olo.z - iz * n.z : iz * raw.z + lo.z + ohi.z - iz * n.z,
+                    ]
+                    out[
+                        olo.x - r.lo.x : ohi.x - r.lo.x,
+                        olo.y - r.lo.y : ohi.y - r.lo.y,
+                        olo.z - r.lo.z : ohi.z - r.lo.z,
+                    ] = np.asarray(jax.device_get(block))
+        return out
+
+    def interior_to_host(self, h: DataHandle, slot: str = "curr") -> np.ndarray:
+        """Whole-interior readback (reference ``interior_to_host``,
+        local_domain.cuh:329-346) — alias of ``quantity_to_host``."""
+        return self.quantity_to_host(h, slot)
 
     def mark_shell_stale(self) -> None:
         """Fast-path steps that skip the shell entirely (the single-device

@@ -279,6 +279,51 @@ def make_exchange_fn_allgather(mesh: Mesh, radius: Radius, spec, dim):
     return exchange
 
 
+def make_exchange_fn_rollcompare(mesh: Mesh, radius: Radius, spec, dim):
+    """Oracle exchange: wrap-pad the LOGICAL field (``jnp.pad(mode='wrap')``,
+    the jnp.roll formulation) and rebuild every shard's raw block by static
+    slicing — a formulation structurally independent of both the ppermute
+    sweeps and the AllGather window-gather, completing the ``MethodFlags``
+    debug set (utils/config.py RollCompare).  Even (unpadded) sizes only."""
+    raw = spec.raw_size()
+    n = spec.sz
+    lo = radius.lo()
+    hi = radius.hi()
+    sharding = NamedSharding(mesh, P(*MESH_AXES))
+
+    @jax.jit
+    def exchange(arrays):
+        def one(arr):
+            g = arr.reshape(dim[0], raw[0], dim[1], raw[1], dim[2], raw[2])
+            g = g[:, lo[0] : lo[0] + n[0], :, lo[1] : lo[1] + n[1], :, lo[2] : lo[2] + n[2]]
+            logical = g.reshape(dim[0] * n[0], dim[1] * n[1], dim[2] * n[2])
+            padded = jnp.pad(
+                logical,
+                ((lo[0], hi[0]), (lo[1], hi[1]), (lo[2], hi[2])),
+                mode="wrap",
+            )
+            rows = []
+            for ix in range(dim[0]):
+                planes = []
+                for iy in range(dim[1]):
+                    cols = [
+                        padded[
+                            ix * n[0] : ix * n[0] + raw[0],
+                            iy * n[1] : iy * n[1] + raw[1],
+                            iz * n[2] : iz * n[2] + raw[2],
+                        ]
+                        for iz in range(dim[2])
+                    ]
+                    planes.append(jnp.concatenate(cols, axis=2))
+                rows.append(jnp.concatenate(planes, axis=1))
+            out = jnp.concatenate(rows, axis=0)
+            return jax.lax.with_sharding_constraint(out, sharding)
+
+        return jax.tree.map(one, arrays)
+
+    return exchange
+
+
 def make_exchange_fn(
     mesh: Mesh,
     radius: Radius,

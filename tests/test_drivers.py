@@ -69,11 +69,15 @@ def test_bench_exchange(capsys):
 
     assert main(["--iters", "2", "--x", "12", "--y", "12", "--z", "12"]) == 0
     out = _capture(capsys)
-    assert out[0] == "name,count,trimean (S),trimean (B/s),stddev,min,avg,max"
+    assert out[0] == (
+        "name,count,trimean (S),trimean (B/s),stddev,min,avg,max,trimean (B/s swept)"
+    )
     assert len(out) == 6  # header + 5 radius configs (bench_exchange.cu:121-195)
     for line in out[1:]:
         cols = line.split(",")
         assert float(cols[2]) > 0 and float(cols[3]) > 0
+        # swept B/s >= modeled B/s: sweeps move full-extent slabs
+        assert float(cols[8]) >= float(cols[3])
 
 
 @pytest.mark.parametrize("backend", ["xla", "pallas"])
@@ -126,6 +130,10 @@ def test_bench_alltoallv(capsys):
     assert "bw" in out and "time" in out and "stencil" in out
     assert "All-to-all 8MiB" in out
     assert "Local 1GiB Remote 100M" in out
+    # the contended (all-pairs-in-flight) totals accompany every matrix
+    for name in ("stencil", "All-to-all 8MiB", "Local 1GiB Remote 100M"):
+        i = out.index(f"{name} concurrent")
+        assert float(out[i + 1]) > 0
 
 
 def test_measure_buf_exchange(capsys):
@@ -135,6 +143,8 @@ def test_measure_buf_exchange(capsys):
     out = _capture(capsys)
     assert out[0] == "x"
     assert "final x (MiB)" in out
+    # each controller iteration reports the contended traversal total
+    assert any(l.startswith("y_concurrent ") and float(l.split()[1]) > 0 for l in out)
     final = out[out.index("final x (MiB)") + 1 :]
     vals = [float(v) for line in final for v in line.split()]
     assert any(v > 0 for v in vals)

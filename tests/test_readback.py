@@ -1,0 +1,104 @@
+"""Tier-2: region readback + the RollCompare oracle + the sweep-bytes model.
+
+* ``region_to_host`` — arbitrary-region readback in global coords (reference
+  LocalDomain::region_to_host, src/local_domain.cu:97).
+* ``MethodFlags.RollCompare`` — the wrap-pad exchange oracle must agree
+  bit-exactly with both the production ppermute exchange and the AllGather
+  debug method.
+* ``sweep_bytes`` — the honest wire-byte model for the 3-axis sweeps: equals
+  the 26-message model for single-axis radii, strictly exceeds it for
+  face-only multi-axis radii (the halo-overhang traffic), and matches it for
+  full constant radii (where every edge/corner message exists).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.core.dim3 import Dim3, Rect3
+from stencil_tpu.core.geometry import LocalSpec, exchange_bytes, sweep_bytes
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.utils.config import MethodFlags
+
+
+def _ripple_domain(size=16, radius=2, methods=MethodFlags.All):
+    dd = DistributedDomain(size, size, size)
+    dd.set_radius(Radius.constant(radius))
+    dd.set_methods(methods)
+    h = dd.add_data("q", dtype=jnp.float32)
+    dd.realize()
+    dd.init_by_coords(
+        h, lambda x, y, z: (x * 10000 + y * 100 + z).astype(jnp.float32)
+    )
+    return dd, h
+
+
+@pytest.mark.parametrize(
+    "region",
+    [
+        Rect3(Dim3(0, 0, 0), Dim3(16, 16, 16)),  # whole domain
+        Rect3(Dim3(3, 5, 7), Dim3(11, 9, 13)),  # straddles shard boundaries
+        Rect3(Dim3(9, 0, 2), Dim3(10, 4, 16)),  # thin slab in one x-shard row
+    ],
+)
+def test_region_to_host(region):
+    dd, h = _ripple_domain()
+    got = dd.region_to_host(h, region)
+    full = dd.quantity_to_host(h)
+    np.testing.assert_array_equal(
+        got,
+        full[
+            region.lo.x : region.hi.x,
+            region.lo.y : region.hi.y,
+            region.lo.z : region.hi.z,
+        ],
+    )
+
+
+def test_interior_to_host_alias():
+    dd, h = _ripple_domain()
+    np.testing.assert_array_equal(dd.interior_to_host(h), dd.quantity_to_host(h))
+
+
+@pytest.mark.parametrize("oracle", [MethodFlags.RollCompare, MethodFlags.AllGather])
+def test_oracle_exchange_matches_ppermute(oracle):
+    dd_p, h_p = _ripple_domain(methods=MethodFlags.All)
+    dd_o, h_o = _ripple_domain(methods=oracle)
+    dd_p.exchange()
+    dd_o.exchange()
+    np.testing.assert_array_equal(dd_p.raw_to_host(h_p), dd_o.raw_to_host(h_o))
+
+
+def test_rollcompare_uneven_rejected():
+    dd = DistributedDomain(17, 16, 16)
+    dd.set_radius(Radius.constant(1))
+    dd.set_methods(MethodFlags.RollCompare)
+    dd.add_data("q")
+    with pytest.raises(ValueError, match="even sizes"):
+        dd.realize()
+
+
+def test_sweep_bytes_model():
+    # single-axis radius: sweeps send exactly the two face messages
+    r = Radius.constant(0)
+    r.set_dir(Dim3(1, 0, 0), 2)
+    r.set_dir(Dim3(-1, 0, 0), 2)
+    spec = LocalSpec.make(Dim3(8, 8, 8), Dim3(0, 0, 0), r)
+    assert sweep_bytes(spec, [4]) == exchange_bytes(spec, [4])
+
+    # faces-only on all axes: sweeps also carry the y/z halo overhang
+    r = Radius.constant(0)
+    r.set_face(1)
+    spec = LocalSpec.make(Dim3(8, 8, 8), Dim3(0, 0, 0), r)
+    assert sweep_bytes(spec, [4]) > exchange_bytes(spec, [4])
+
+    # full constant radius: edge data rides BOTH its axes' sweeps and corner
+    # data all three, so the wire count exceeds the 26-message model by
+    # exactly one extra copy of the edges and two of the corners
+    spec = LocalSpec.make(Dim3(8, 8, 8), Dim3(0, 0, 0), Radius.constant(2))
+    edge_cells = 12 * (2 * 2 * 8)
+    corner_cells = 8 * (2 * 2 * 2)
+    assert sweep_bytes(spec, [4]) == exchange_bytes(spec, [4]) + 4 * (
+        edge_cells + 2 * corner_cells
+    )

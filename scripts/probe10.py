@@ -1,0 +1,63 @@
+"""Probe: temporal-blocking sweep — k jacobi levels per plane pipeline.
+
+The DMA fabric caps plane pipelines at ~350 GB/s (probe9e/9f), i.e.
+~44 Gcells/s at 8 B/cell.  jacobi_wrap_step(k) reads/writes each plane once
+per k iterations (~8/k B/cell): ceiling ~= k * 44 until the VPU takes over.
+Sweep k, bit-check each against k applications of k=1.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+from stencil_tpu.ops.jacobi_pallas import jacobi_wrap_step
+
+N = 512
+STEPS = 96  # divisible by every k below
+
+
+def main():
+    rt = host_round_trip_s()
+    print(f"host rt: {rt*1e3:.1f} ms", flush=True)
+    init_np = np.asarray(
+        jax.random.uniform(jax.random.PRNGKey(0), (N, N, N), jnp.float32)
+    )
+    fresh = lambda: jnp.asarray(init_np)
+
+    @partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)
+    def loop(b, s, k):
+        return lax.fori_loop(0, s // k, lambda _, x: jacobi_wrap_step(x, k=k), b)
+
+    ref = None
+    for k in (1, 2, 3, 4, 6, 8):
+        state = {"a": fresh()}
+
+        def run(n, k=k):
+            # n is the inner count in units of k-iterations; run n*k iters
+            state["a"] = loop(state["a"], n * k, k)
+            float(jnp.sum(state["a"][0, 0, 0:1]))
+
+        try:
+            samples, _ = timed_inner_loop(run, STEPS // k, rt, 3)
+        except Exception as e:
+            print(f"k={k}  FAILED: {type(e).__name__}: {str(e)[:150]}", flush=True)
+            continue
+        t = min(samples) / k  # per single jacobi iteration
+        got = np.asarray(loop(fresh(), STEPS, k))
+        if ref is None:
+            ref = got
+        line = (
+            f"k={k}  {t*1e3:.3f} ms/iter  {N**3/t/1e9:.1f} Gcells/s"
+            f"  bit-exact={np.array_equal(got, ref)}"
+        )
+        print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Probe: is the k>=4 wrap-kernel compile failure VMEM pressure or a
+compiler limit?  Sweep k at several domain sizes; record compile ok + perf.
+VMEM estimate per k: (2k scratch + ~4 pipeline + 1 d2) Y*Z planes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+from stencil_tpu.ops.jacobi_pallas import jacobi_wrap_step
+
+
+def main():
+    rt = host_round_trip_s()
+    print(f"host rt: {rt*1e3:.1f} ms", flush=True)
+    for N, ks in ((256, (3, 4, 5, 6, 8)), (384, (3, 4, 6)), (640, (2, 3, 4))):
+        steps = 48
+        init_np = np.asarray(
+            jax.random.uniform(jax.random.PRNGKey(0), (N, N, N), jnp.float32)
+        )
+        fresh = lambda: jnp.asarray(init_np)
+
+        @partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)
+        def loop(b, s, k):
+            return lax.fori_loop(0, s // k, lambda _, x: jacobi_wrap_step(x, k=k), b)
+
+        ref = np.asarray(loop(fresh(), steps, 1))
+        for k in ks:
+            if steps % k:
+                continue
+            state = {"a": fresh()}
+
+            def run(n, k=k):
+                state["a"] = loop(state["a"], n * k, k)
+                float(jnp.sum(state["a"][0, 0, 0:1]))
+
+            try:
+                samples, _ = timed_inner_loop(run, steps // k, rt, 3)
+            except Exception as e:
+                print(f"N={N} k={k}  FAILED: {type(e).__name__}: {str(e)[:120]}", flush=True)
+                continue
+            t = min(samples) / k
+            got = np.asarray(loop(fresh(), steps, k))
+            print(
+                f"N={N} k={k}  {t*1e3:.3f} ms/iter  {N**3/t/1e9:.1f} Gcells/s"
+                f"  vmem_est={(2*k+5)*N*N*4/1e6:.1f}MB"
+                f"  bit-exact={np.array_equal(got, ref)}",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,8 @@ class Jacobi3D:
         dtype=jnp.float32,
         kernel_impl: str = "jnp",  # "jnp" (XLA slices) | "pallas" (plane streaming)
         interpret: bool = False,  # pallas interpreter mode (CPU testing)
+        temporal_k="auto",  # wrap-path temporal blocking depth (int | "auto")
+        pallas_path: str = "auto",  # "auto" | "wrap" | "slab" | "shell"
     ):
         self.dd = DistributedDomain(x, y, z)
         # radius 1 on faces only (jacobi3d.cu:205-214)
@@ -55,6 +57,10 @@ class Jacobi3D:
         self.overlap = overlap
         self.kernel_impl = kernel_impl
         self.interpret = interpret
+        self.temporal_k = temporal_k
+        if pallas_path not in ("auto", "wrap", "slab", "shell"):
+            raise ValueError(f"unknown pallas_path {pallas_path!r}")
+        self.pallas_path_request = pallas_path
         self._step = None
         # fast paths (wrap/slab kernels) advance interiors only; the carried
         # shell goes stale and raw readback must re-exchange (mark_shell_stale)
@@ -105,6 +111,7 @@ class Jacobi3D:
 
         from stencil_tpu.ops.exchange import halo_exchange_shard
         from stencil_tpu.ops.jacobi_pallas import (
+            choose_temporal_k,
             jacobi_plane_step,
             jacobi_wrap_step,
             yz_dist2_plane,
@@ -112,7 +119,17 @@ class Jacobi3D:
         from stencil_tpu.parallel.mesh import MESH_AXES
 
         dd = self.dd
-        if dd.num_subdomains() == 1:
+        want = self.pallas_path_request
+        if want == "wrap" and dd.num_subdomains() != 1:
+            raise ValueError("pallas_path='wrap' requires a single subdomain")
+        if want == "slab" and (
+            any(v is not None for v in dd._valid_last) or dd.local_spec().sz.x < 2
+        ):
+            raise ValueError(
+                "pallas_path='slab' requires even (unpadded) sizes and >= 2 "
+                "x-planes per shard"
+            )
+        if want == "wrap" or (want == "auto" and dd.num_subdomains() == 1):
             # single-device fast path: the periodic wrap folds into the
             # kernel's index maps/rotates — no shell reads, no exchange (the
             # reference's same-GPU translate kernels disappear too).  The
@@ -125,6 +142,10 @@ class Jacobi3D:
             interpret = self.interpret
             self._marks_shell_stale = True
             self._pallas_path = "wrap"
+            k = choose_temporal_k(
+                (n.x, n.y, n.z), self.h.dtype.itemsize, self.temporal_k
+            )
+            self._wrap_k = k
 
             @partial(jax.jit, static_argnums=1, donate_argnums=0)
             def step(curr, steps: int = 1):
@@ -132,13 +153,28 @@ class Jacobi3D:
                 block = lax.slice(
                     arr, (lo.x, lo.y, lo.z), (lo.x + n.x, lo.y + n.y, lo.z + n.z)
                 )
-                block = lax.fori_loop(
-                    0, steps, lambda _, b: jacobi_wrap_step(b, interpret=interpret), block
-                )
+                # temporal blocking: steps//k wavefront dispatches touch HBM
+                # once per k iterations; the remainder runs unblocked.  Each
+                # level's arithmetic is identical to a k=1 pass, so any
+                # (blocked, remainder) split is bit-exact vs k=1.
+                blocked, rem = divmod(steps, k)
+                if blocked:
+                    block = lax.fori_loop(
+                        0,
+                        blocked,
+                        lambda _, b: jacobi_wrap_step(b, interpret=interpret, k=k),
+                        block,
+                    )
+                if rem:
+                    # one k=rem wavefront (rem < k <= X//2 so always valid);
+                    # bit-exact and one HBM pass instead of rem
+                    block = jacobi_wrap_step(block, interpret=interpret, k=rem)
                 return {name: lax.dynamic_update_slice(arr, block, (lo.x, lo.y, lo.z))}
 
             return step
-        if all(v is None for v in dd._valid_last) and dd.local_spec().sz.x >= 2:
+        if want in ("auto", "slab") and (
+            all(v is None for v in dd._valid_last) and dd.local_spec().sz.x >= 2
+        ):
             return self._make_slab_step()
         self._pallas_path = "shell"
         n = dd.local_spec().sz

@@ -203,19 +203,24 @@ def halo_exchange_multi(
 
         blend = (
             axis != 0
-            and not uneven
             and halo_blend.enabled()
             and all(b.ndim == 3 and halo_blend.supports(b.dtype) for b in blocks)
         )
         interp = halo_blend.interpret_mode()
         for j, b in enumerate(blocks):
             if lo_recv is not None:
+                # the low halo sits at 0 even on padded axes, so the static
+                # kernel serves both cases
                 if blend:
                     b = halo_blend.blend_slab(b, lo_recv[j], axis, 0, interpret=interp)
                 else:
                     b = b.at[axslice(b, 0, r_lo)].set(lo_recv[j])
             if hi_recv is not None:
-                if uneven:
+                if uneven and blend:
+                    b = halo_blend.blend_slab_dynamic(
+                        b, hi_recv[j], axis, r_lo + n_valid, interpret=interp
+                    )
+                elif uneven:
                     b = lax.dynamic_update_slice(
                         b, hi_recv[j], dyn_starts(b, r_lo + n_valid)
                     )

@@ -69,9 +69,9 @@ def vma_check(dtypes, valid_last=None, ndim_extra: int = 0) -> bool:
         return True
     if not all(supports(dt) for dt in dtypes):
         return True
-    # blend runs only on y/z axes that divide evenly (valid_last entry None)
-    if valid_last is not None and valid_last[1] is not None and valid_last[2] is not None:
-        return True
+    # padded y/z axes blend too (blend_slab_dynamic), so valid_last does not
+    # re-enable validation
+    del valid_last
     return False
 
 
@@ -147,3 +147,98 @@ def blend_slab(
         input_output_aliases={0: 0},
         interpret=interpret,
     )(block, slab)
+
+
+def blend_slab_dynamic(
+    block: jax.Array,
+    slab: jax.Array,
+    axis: int,
+    pos: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """``blend_slab`` with a TRACED per-shard offset ``pos`` — the padded
+    (uneven) axes case, where the +axis halo lands right after the shard's
+    own valid cells (``r_lo + n_valid``, differing on the last shard).  The
+    offset rides scalar prefetch (``pltpu.PrefetchScalarGridSpec``) so the
+    grid's index map picks the touched tiles per shard at run time; inside
+    the kernel the slab rows land via iota==row masks (slab widths are a few
+    cells, so ``r`` masked selects beat any gather).  Without this, padded
+    domains fall back to ``dynamic_update_slice`` slivers — the full-domain
+    relayout trap this module exists to dodge (see module docstring).
+
+    The grid visits ``nb`` tiles starting at the one containing ``pos``,
+    indexed MODULO ntiles: a width-r region spans at most nb tiles at any
+    alignment, and when it spans fewer the surplus visits wrap to distinct
+    low tiles where the kernel's row mask matches nothing and the body is an
+    identity copy.  (Clamping instead would revisit the last tile, and with
+    resident-block semantics the unconditional ``out = in`` copy of the
+    revisit would clobber the rows blended by the first visit.)
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    assert axis in (1, 2), axis
+    X, Y, Z = block.shape
+    r = slab.shape[axis]
+    tile = _sublane(block.dtype) if axis == 1 else 128
+    ext = (Y, Z)[axis - 1]
+    ntiles = -(-ext // tile)
+    # worst-case tiles a width-r region can span at any alignment
+    nb = min((r - 1) // tile + 2, ntiles)
+    bx = min(8, X)
+    gx = -(-X // bx)
+    pos = jnp.asarray(pos, jnp.int32).reshape((1,))
+
+    def kernel(pos_ref, in_ref, slab_ref, out_ref):
+        g = pl.program_id(1)
+        p = pos_ref[0]
+        t0 = p // tile
+        out_ref[...] = in_ref[...]
+        # slab row s lands at row p + s - (t0+g)*tile of the UNWRAPPED tile
+        # t0+g; out-of-[0,tile) targets (rows owned by other visits, or any
+        # row of a wrapped surplus visit) match no iota and write nothing
+        base = p - (t0 + g) * tile
+        for s in range(r):
+            t = base + s
+            if axis == 1:
+                rows = jax.lax.broadcasted_iota(jnp.int32, (bx, tile, Z), 1)
+                sl = slab_ref[:, s, :][:, None, :]
+            else:
+                rows = jax.lax.broadcasted_iota(jnp.int32, (bx, Y, tile), 2)
+                sl = slab_ref[:, :, s][:, :, None]
+            out_ref[...] = jnp.where(rows == t, sl, out_ref[...])
+
+    if axis == 1:
+        blk = (bx, tile, Z)
+        sblk = (bx, r, Z)
+    else:
+        blk = (bx, Y, tile)
+        sblk = (bx, Y, r)
+
+    # index maps take scalar-prefetch refs AFTER the grid indices (the kernel
+    # takes them first)
+    def index(i, g, pos_ref):
+        tidx = jax.lax.rem(
+            pos_ref[0] // tile + jnp.asarray(g, jnp.int32), jnp.int32(ntiles)
+        )
+        return (i, tidx, 0) if axis == 1 else (i, 0, tidx)
+
+    def sindex(i, g, pos_ref):
+        return (i, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(gx, nb),
+        in_specs=[
+            pl.BlockSpec(blk, index),
+            pl.BlockSpec(sblk, sindex),
+        ],
+        out_specs=pl.BlockSpec(blk, index),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(block.shape, block.dtype),
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(pos, block, slab)

@@ -54,30 +54,79 @@ def yz_dist2_plane(origin_y, origin_z, shape_yz: Tuple[int, int], global_size) -
     return ((y - cy) ** 2)[:, None] + ((z - cz) ** 2)[None, :]
 
 
+#: VMEM the auto-chosen temporal blocking depth may claim: 2k ring planes +
+#: ~4 pipeline (in/out double-buffer) planes + the resident d2 plane.
+#: Calibrated on v5e (scripts/probe10/probe10b): 512^2-plane k=3 (11.5 MB
+#: estimated) compiles and runs; k=4 (13.6 MB) is rejected by the compiler.
+_WRAP_VMEM_BUDGET = 11_600_000
+
+#: deepest depth validated on hardware; beyond it each level adds < 5%
+#: (probe10b: 256^3 k=6 134.0 -> k=8 135.2 Gcells/s) so there is no hurry to
+#: re-qualify deeper wavefronts on new toolchains
+_WRAP_MAX_K = 6
+
+
+def choose_temporal_k(shape: Tuple[int, int, int], itemsize: int, requested="auto") -> int:
+    """Pick the wrap kernel's temporal blocking depth: the deepest k whose
+    VMEM footprint fits the calibrated budget (``auto``), or a validated
+    explicit int.  Measured sweep (scripts/probe10b, v5e f32): 512^3
+    41 -> 94 Gcells/s (k=3), 384^3 -> 120 (k=6), 256^3 -> 134 (k=6)."""
+    X, Y, Z = shape
+    if requested != "auto":
+        k = int(requested)
+        if not 1 <= k <= max(1, X // 2):
+            raise ValueError(f"temporal_k={k} needs 1 <= k <= X//2 = {X // 2}")
+        if (2 * k + 5) * Y * Z * itemsize > _WRAP_VMEM_BUDGET:
+            from stencil_tpu.utils.logging import log_warn
+
+            log_warn(
+                f"temporal_k={k} estimates {(2 * k + 5) * Y * Z * itemsize / 1e6:.1f}"
+                f" MB of VMEM (> calibrated {_WRAP_VMEM_BUDGET / 1e6:.1f} MB budget);"
+                " expect a compile failure on real TPU (fine in interpret mode)"
+            )
+        return k
+    k = 1
+    for cand in range(2, _WRAP_MAX_K + 1):
+        if cand <= X // 2 and (2 * cand + 5) * Y * Z * itemsize <= _WRAP_VMEM_BUDGET:
+            k = cand
+    return k
+
+
 def jacobi_wrap_step(
     block: jax.Array,
     interpret: bool = False,
+    k: int = 1,
 ) -> jax.Array:
-    """One Jacobi iteration over the WHOLE (unsharded) domain with periodic
-    wrap folded into the kernel — the single-device fast path.
+    """``k`` Jacobi iterations over the WHOLE (unsharded) domain with the
+    periodic wrap folded into the kernel — the single-device fast path.
 
     With one device there is no neighbor: the reference still runs its
     same-GPU ``PeerAccessSender`` translate kernels to fill the shell
     (tx_cuda.cuh:39-104); here the shell disappears entirely.  The x-wrap
-    rides the block index map (``i % X``: planes 0 and 1 are re-fetched after
-    the last plane so planes X-1 and 0 can close the ring), and the y/z wrap
-    is a lane/sublane rotate of the resident plane — measured free against
-    the plane DMA (scripts/probe3.py: 45.7 Gcells/s vs 16.3 for the
-    shell+exchange formulation on the same chip/day).
+    rides the block index map (planes are re-fetched modulo X after the last
+    plane so every level can close its ring), and the y/z wrap is a
+    lane/sublane rotate of the resident plane.
 
-    ``block`` is the bare (X, Y, Z) logical domain; semantics match
-    ``models.jacobi.Jacobi3D._kernel`` exactly (verified bit-exact against
-    the jnp.roll formulation on hardware).
+    ``k > 1`` is TEMPORAL BLOCKING (a wavefront over time steps): each HBM
+    plane is read ONCE and the output written ONCE per ``k`` iterations —
+    ~8/k bytes/cell.  This chip's DMA fabric caps pallas pipelines at
+    ~350 GB/s (scripts/probe9e/9f: one giant HBM->HBM DMA, multi-queue, and
+    multi-buffer all plateau there, while XLA vector-core fusions stream
+    ~720), so at k=1 the plane pipeline is already AT its hardware ceiling
+    and only temporal reuse can pass it.  Level ``s`` consumes the planes of
+    level ``s-1`` as they emerge; each level keeps a 2-plane ring; the replay
+    (grid X + 2k) recomputes each level's early planes so the x-wrap closes
+    for every level — the k=1 schedule is exactly the original wrap kernel.
+
+    ``block`` is the bare (X, Y, Z) logical domain; semantics match ``k``
+    applications of ``models.jacobi.Jacobi3D._kernel`` exactly (bit-exact:
+    summation order is identical per level).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     X, Y, Z = block.shape
+    assert 1 <= k <= X // 2, (k, X)
     gx = X
     hot_x, cold_x, in_r2 = sphere_params(gx)
 
@@ -87,46 +136,43 @@ def jacobi_wrap_step(
         return pltpu.roll(v, amt % v.shape[axis], axis)
 
     def kernel(in_ref, d2_ref, out_ref, ring):
+        # ring[s] holds the two most recent level-s planes (level 0 = input)
         i = pl.program_id(0)
-        cur = in_ref[0]
-
-        @pl.when(i >= 2)
-        def _():
-            prev = ring[i % 2]  # plane (i-2) % X
-            cent = ring[(i + 1) % 2]  # plane (i-1) % X
+        d2 = d2_ref[...]
+        vals = in_ref[0]  # level-0 plane i (mod X)
+        for s in range(1, k + 1):
+            # level-s plane (i - s) from level-(s-1) planes (i-s-1, i-s,
+            # i-s+1); early steps compute garbage that the replay rewrites
+            prev = ring[s - 1, i % 2]  # plane i-s-1
+            cent = ring[s - 1, (i + 1) % 2]  # plane i-s
+            ring[s - 1, i % 2] = vals  # push plane i-s+1 (after prev read)
             val = (
                 prev
-                + cur
+                + vals
                 + roll(cent, 1, 0)
                 + roll(cent, -1, 0)
                 + roll(cent, 1, 1)
                 + roll(cent, -1, 1)
             ) / 6.0
-            x_g = (i - 1) % X
-            d2 = d2_ref[...]
+            x_g = (i - s) % X
             val = jnp.where(d2 < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
             val = jnp.where(d2 < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
-            out_ref[0] = val.astype(cur.dtype)
-
-        @pl.when(i < 2)
-        def _():
-            out_ref[0] = cur  # placeholder; rewritten at steps X, X+1
-
-        ring[i % 2] = cur
+            vals = val.astype(vals.dtype)
+        out_ref[0] = vals  # level-k plane (i - k) % X; last write is valid
 
     d2 = yz_dist2_plane(0, 0, (Y, Z), block.shape)
 
     return pl.pallas_call(
         kernel,
-        grid=(X + 2,),
+        grid=(X + 2 * k,),
         in_specs=[
             pl.BlockSpec((1, Y, Z), lambda i: (i % X, 0, 0)),
             # constant index map: fetched once, stays resident in VMEM
             pl.BlockSpec((Y, Z), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, Y, Z), lambda i: ((i - 1) % X, 0, 0)),
+        out_specs=pl.BlockSpec((1, Y, Z), lambda i: ((i - k) % X, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
-        scratch_shapes=[pltpu.VMEM((2, Y, Z), block.dtype)],
+        scratch_shapes=[pltpu.VMEM((k, 2, Y, Z), block.dtype)],
         interpret=interpret,
     )(block, d2.astype(jnp.int32))
 

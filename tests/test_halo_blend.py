@@ -45,6 +45,73 @@ def test_blend_mid_position_spanning_tiles():
     np.testing.assert_array_equal(np.asarray(got), want)
 
 
+@pytest.mark.parametrize("axis", [1, 2])
+@pytest.mark.parametrize("r", [1, 3, 5])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_blend_dynamic_equals_dus_all_positions(axis, r, dtype):
+    """Traced-offset blend == DUS at every legal offset, notably those whose
+    region ends inside the LAST tile (the revisit-clobber hazard the modulo
+    index map exists for)."""
+    from stencil_tpu.ops.halo_blend import blend_slab_dynamic
+
+    shape = (5, 21, 19)
+    rng = np.random.default_rng(2)
+    block = jnp.asarray(rng.random(shape), dtype=dtype)
+    slab_shape = list(shape)
+    slab_shape[axis] = r
+    slab = jnp.asarray(rng.random(slab_shape), dtype=dtype)
+
+    blend = jax.jit(
+        lambda b, s, p: blend_slab_dynamic(b, s, axis, p, interpret=True)
+    )
+    for pos in range(shape[axis] - r + 1):
+        idx = [slice(None)] * 3
+        idx[axis] = slice(pos, pos + r)
+        want = np.asarray(block).copy()
+        want[tuple(idx)] = np.asarray(slab)
+        got = blend(block, slab, jnp.int32(pos))
+        np.testing.assert_array_equal(np.asarray(got), want, err_msg=f"pos={pos}")
+
+
+def test_blend_dynamic_spans_tile_boundary():
+    """r=5 slab crossing the f32 sublane-tile boundary at a traced offset."""
+    from stencil_tpu.ops.halo_blend import blend_slab_dynamic
+
+    shape = (4, 24, 16)
+    rng = np.random.default_rng(3)
+    block = jnp.asarray(rng.random(shape), dtype=jnp.float32)
+    slab = jnp.asarray(rng.random((4, 5, 16)), dtype=jnp.float32)
+    want = np.asarray(block).copy()
+    want[:, 6:11, :] = np.asarray(slab)
+    got = jax.jit(lambda b, s, p: blend_slab_dynamic(b, s, 1, p, interpret=True))(
+        block, slab, jnp.int32(6)
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_uneven_exchange_with_blend_forced_matches_dus(monkeypatch):
+    """Padded (uneven) domain: exchange with the dynamic blend kernels forced
+    equals the DUS path — the reference handles uneven sizes at full speed
+    (partition.hpp:83-114) and so must we."""
+    from stencil_tpu.core.radius import Radius
+    from stencil_tpu.domain import DistributedDomain
+
+    def run():
+        dd = DistributedDomain(15, 13, 19)  # padded on every axis over 8 devs
+        dd.set_radius(Radius.face_edge_corner(2, 1, 1))
+        h = dd.add_data("q")
+        dd.realize()
+        dd.init_by_coords(h, lambda x, y, z: x * 10000.0 + y * 100.0 + z)
+        dd.exchange()
+        return dd.raw_to_host(h)
+
+    monkeypatch.setenv("STENCIL_HALO_BLEND", "0")
+    ref = run()
+    monkeypatch.setenv("STENCIL_HALO_BLEND", "1")
+    got = run()
+    np.testing.assert_array_equal(ref, got)
+
+
 def test_exchange_with_blend_forced_matches_dus(monkeypatch):
     """Full exchange with STENCIL_HALO_BLEND=1 equals the DUS path."""
     from stencil_tpu.core.radius import Radius

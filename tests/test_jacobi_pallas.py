@@ -38,6 +38,53 @@ def test_pallas_single_device_spheres_active():
     assert t[20, 15, 15] == pytest.approx(0.0)
 
 
+@pytest.mark.parametrize("k", [2, 3])
+def test_wrap_temporal_blocking_bit_exact(k):
+    """k temporally-blocked levels == k plain applications, bitwise: each
+    level's arithmetic (summation order, forcing selects) is identical to a
+    k=1 pass, so the wavefront must not change a single ulp."""
+    import jax.numpy as jnp
+
+    from stencil_tpu.ops.jacobi_pallas import jacobi_wrap_step
+
+    rng = np.random.default_rng(7)
+    b0 = jnp.asarray(rng.random((12, 16, 16)), jnp.float32)
+    ref = b0
+    for _ in range(k):
+        ref = jacobi_wrap_step(ref, interpret=True)
+    got = jacobi_wrap_step(b0, interpret=True, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_wrap_temporal_blocking_model_with_remainder():
+    """Model path with temporal_k=3 and steps=5 (1 blocked dispatch + 2
+    remainder) equals the plain k=1 wrap path exactly."""
+    dev = jax.devices()[:1]
+    a = Jacobi3D(26, 24, 22, kernel_impl="pallas", interpret=True, devices=dev,
+                 temporal_k=1)
+    a.realize()
+    b = Jacobi3D(26, 24, 22, kernel_impl="pallas", interpret=True, devices=dev,
+                 temporal_k=3)
+    b.realize()
+    assert b._wrap_k == 3
+    a.step(5)
+    b.step(5)
+    np.testing.assert_array_equal(a.temperature(), b.temperature())
+
+
+def test_choose_temporal_k():
+    from stencil_tpu.ops.jacobi_pallas import choose_temporal_k
+
+    assert choose_temporal_k((512, 512, 512), 4) == 3
+    assert choose_temporal_k((4, 64, 64), 4) == 2  # X//2 caps
+    assert choose_temporal_k((2, 64, 64), 4) == 1
+    # budget caps: huge planes leave no VMEM for the ring
+    assert choose_temporal_k((512, 2048, 2048), 4) == 1
+    assert choose_temporal_k((512, 128, 128), 4, requested=2) == 2
+    with pytest.raises(ValueError):
+        choose_temporal_k((4, 64, 64), 4, requested=3)
+
+
 def test_wrap_fast_path_matches_jnp_single_device():
     """Single-device pallas uses the wrap-in-kernel path (no shell reads, no
     exchange); must equal the generic make_step formulation exactly."""

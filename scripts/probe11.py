@@ -1,0 +1,46 @@
+"""Probe: production multi-device paths on one real chip (mesh [1,1,1],
+self-permute): slab (per-step ppermutes + radius-1 kernel) vs wavefront
+(m-shell exchange every m steps + m-level wavefront kernel).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+from stencil_tpu.models.jacobi import Jacobi3D
+
+N = 512
+STEPS = 96
+
+
+def run(path, **kw):
+    rt = host_round_trip_s()
+    model = Jacobi3D(N, N, N, devices=jax.devices()[:1], kernel_impl="pallas",
+                     pallas_path=path, **kw)
+    model.realize()
+
+    def go(n):
+        model.step(n * STEPS)
+        float(jnp.sum(model.dd.get_curr(model.h)))
+
+    samples, _ = timed_inner_loop(go, 1, rt, 3)
+    t = min(samples) / STEPS
+    extra = f" m={model._wavefront_m}" if path == "wavefront" else ""
+    print(f"{path}{extra}: {t*1e3:.3f} ms/iter  {N**3/t/1e9:.1f} Gcells/s", flush=True)
+    return model
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+    a = run("slab")
+    b = run("wavefront")
+    ta = a.temperature()
+    tb = b.temperature()
+    print(f"slab-vs-wavefront allclose: {np.allclose(ta, tb, rtol=1e-6)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Probe: isolate the hardware-only wavefront mismatch (probe11).
+
+A) wrap vs wavefront vs slab vs jnp paths, small N, compiled on TPU, bitwise.
+B) radius-2 ripple: exchange on hardware, verify the whole raw shell.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.models.jacobi import Jacobi3D
+
+
+def model_temp(path, steps, **kw):
+    m = Jacobi3D(64, 64, 64, devices=jax.devices()[:1], kernel_impl="pallas",
+                 pallas_path=path, **kw)
+    m.realize()
+    m.step(steps)
+    return m.temperature()
+
+
+def main():
+    jnp_model = Jacobi3D(64, 64, 64, devices=jax.devices()[:1])
+    jnp_model.realize()
+    jnp_model.step(6)
+    ref = jnp_model.temperature()
+
+    for path, kw in (("wrap", {}), ("slab", {}), ("wavefront", {"temporal_k": 2}),
+                     ("wavefront", {"temporal_k": 3})):
+        tag = f"{path}{kw.get('temporal_k','')}"
+        try:
+            got = model_temp(path, 6, **kw)
+        except Exception as e:
+            print(f"{tag}: FAILED {type(e).__name__}: {str(e)[:120]}", flush=True)
+            continue
+        print(f"{tag}: allclose-vs-jnp={np.allclose(got, ref, rtol=1e-6)}"
+              f"  maxdiff={np.max(np.abs(got - ref)):.3e}", flush=True)
+
+    # B: radius-2 exchange shell check on hardware
+    dd = DistributedDomain(48, 48, 48)
+    dd.set_devices(jax.devices()[:1])
+    dd.set_radius(Radius.face_edge_corner(2, 2, 2))
+    h = dd.add_data("q")
+    dd.realize()
+    dd.init_by_coords(h, lambda x, y, z: x * 10000.0 + y * 100.0 + z)
+    dd.exchange()
+    raw = dd.raw_to_host(h)
+    spec = dd.local_spec()
+    lo = dd._shell_radius.lo()
+    n = spec.sz
+    ok = True
+    for xi in range(raw.shape[0]):
+        for yi in (0, 1, raw.shape[1] - 1):
+            for zi in (0, 1, raw.shape[2] - 1):
+                gx = (xi - lo.x) % 48
+                gy = (yi - lo.y) % 48
+                gz = (zi - lo.z) % 48
+                want = gx * 10000.0 + gy * 100.0 + gz
+                if raw[xi, yi, zi] != want:
+                    ok = False
+                    print(f"shell mismatch at raw ({xi},{yi},{zi}): "
+                          f"{raw[xi, yi, zi]} != {want}", flush=True)
+                    break
+            if not ok:
+                break
+        if not ok:
+            break
+    print(f"radius-2 ripple shell on hardware: {'OK' if ok else 'FAIL'}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

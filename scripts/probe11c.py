@@ -1,0 +1,49 @@
+"""Probe: localize the 512^3 slab-vs-wavefront mismatch (probe11) — compare
+slab and wavefront against the validated wrap path at 512^3 after 6 steps,
+and report where any difference lives (interior vs faces).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from stencil_tpu.models.jacobi import Jacobi3D
+
+
+def temp(path, steps=6, **kw):
+    m = Jacobi3D(512, 512, 512, devices=jax.devices()[:1], kernel_impl="pallas",
+                 pallas_path=path, **kw)
+    m.realize()
+    m.step(steps)
+    return m.temperature()
+
+
+def where_differs(a, b):
+    d = np.abs(a - b)
+    if d.max() == 0:
+        return "identical"
+    idx = np.argwhere(d > 1e-6)
+    if idx.size == 0:
+        return f"allclose (maxdiff {d.max():.2e})"
+    mins = idx.min(axis=0)
+    maxs = idx.max(axis=0)
+    return (f"{len(idx)} cells differ, bbox {tuple(mins)}..{tuple(maxs)}, "
+            f"maxdiff {d.max():.2e}")
+
+
+def main():
+    ref = temp("wrap")
+    for path, kw in (("slab", {}), ("wavefront", {"temporal_k": 2}),
+                     ("wavefront", {"temporal_k": 3})):
+        tag = f"{path}{kw.get('temporal_k','')}"
+        try:
+            got = temp(path, **kw)
+        except Exception as e:
+            print(f"{tag}: FAILED {type(e).__name__}: {str(e)[:120]}", flush=True)
+            continue
+        print(f"{tag} vs wrap: {where_differs(ref, got)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

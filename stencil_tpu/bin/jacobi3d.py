@@ -42,6 +42,13 @@ def main(argv=None) -> int:
         help="pallas plane-streaming kernel (fast) or XLA slices",
     )
     p.add_argument(
+        "--pallas-path",
+        choices=["auto", "wrap", "slab", "shell", "wavefront"],
+        default="auto",
+        help="force a specific pallas route (auto: wrap single-device, "
+        "temporally-blocked wavefront multi-device, slab/shell fallbacks)",
+    )
+    p.add_argument(
         "--overlap-report",
         action="store_true",
         help="time overlap=True vs overlap=False (jnp kernel) and report the "
@@ -77,6 +84,7 @@ def main(argv=None) -> int:
         methods=_common.parse_methods(args),
         kernel_impl=kernel_impl,
         interpret=jax.default_backend() == "cpu",
+        pallas_path=args.pallas_path,
     )
     if args.halo_multiplier > 1:
         model.dd.set_halo_multiplier(args.halo_multiplier)

@@ -42,7 +42,7 @@ class Jacobi3D:
         kernel_impl: str = "jnp",  # "jnp" (XLA slices) | "pallas" (plane streaming)
         interpret: bool = False,  # pallas interpreter mode (CPU testing)
         temporal_k="auto",  # wrap-path temporal blocking depth (int | "auto")
-        pallas_path: str = "auto",  # "auto" | "wrap" | "slab" | "shell"
+        pallas_path: str = "auto",  # "auto"|"wrap"|"slab"|"shell"|"wavefront"
     ):
         self.dd = DistributedDomain(x, y, z)
         # radius 1 on faces only (jacobi3d.cu:205-214)
@@ -58,32 +58,174 @@ class Jacobi3D:
         self.kernel_impl = kernel_impl
         self.interpret = interpret
         self.temporal_k = temporal_k
-        if pallas_path not in ("auto", "wrap", "slab", "shell"):
+        if pallas_path not in ("auto", "wrap", "slab", "shell", "wavefront"):
             raise ValueError(f"unknown pallas_path {pallas_path!r}")
         self.pallas_path_request = pallas_path
         self._step = None
         # fast paths (wrap/slab kernels) advance interiors only; the carried
         # shell goes stale and raw readback must re-exchange (mark_shell_stale)
         self._marks_shell_stale = False
-        # which pallas route realize() picked: "wrap" | "slab" | "shell"
+        # which pallas route realize() picked:
+        # "wrap" | "wavefront" | "slab" | "shell"
         self._pallas_path = None
 
     def realize(self) -> None:
+        self._wavefront_m = 0
+        if self.kernel_impl == "pallas" and self.pallas_path_request in ("auto", "wavefront"):
+            # must be decided BEFORE dd.realize(): the wavefront path rides
+            # the halo-multiplier machinery (m-wide shells, exchange every m
+            # steps), which shapes the allocation
+            if self.pallas_path_request == "wavefront":
+                self._wavefront_m = self._plan_wavefront()  # raises if not viable
+            elif self.dd.halo_multiplier() == 1 and self._planned_devices() > 1:
+                try:
+                    m = self._plan_wavefront()
+                except ValueError:
+                    m = 0  # uneven sizes etc. — slab/shell routes handle it
+                # depth 1 buys nothing over the slab route; require real blocking
+                self._wavefront_m = m if m >= 2 else 0
+            if self._wavefront_m:
+                self.dd.set_halo_multiplier(self._wavefront_m)
         self.dd.realize()
         # set compute region to (HOT+COLD)/2 (jacobi3d.cu:15-29, 253-263)
         mid = (HOT_TEMP + COLD_TEMP) / 2
         self.dd.init_by_coords(self.h, lambda x, y, z: jnp.full((), mid) + 0 * (x + y + z))
         if self.kernel_impl == "pallas":
-            # the plane-streaming kernel hard-codes a 1-cell shell ring
-            if self.dd.halo_multiplier() != 1:
-                raise ValueError(
-                    "kernel_impl='pallas' requires halo multiplier 1 "
-                    "(the plane kernel assumes a radius-1 shell); use "
-                    "kernel_impl='jnp' with set_halo_multiplier"
-                )
-            self._step = self._make_pallas_step()
+            if self._wavefront_m:
+                self._step = self._make_wavefront_step()
+            else:
+                # the plane-streaming kernel hard-codes a 1-cell shell ring
+                if self.dd.halo_multiplier() != 1:
+                    raise ValueError(
+                        "kernel_impl='pallas' requires halo multiplier 1 "
+                        "(the plane kernel assumes a radius-1 shell); use "
+                        "kernel_impl='jnp' with set_halo_multiplier, or "
+                        "pallas_path='wavefront' which sets its own"
+                    )
+                self._step = self._make_pallas_step()
         else:
             self._step = self.dd.make_step(self._kernel, overlap=self.overlap)
+
+    def _planned_devices(self) -> int:
+        import jax
+
+        devs = self.dd._devices
+        return len(devs) if devs is not None else len(jax.devices())
+
+    def _plan_wavefront(self) -> int:
+        """Choose the wavefront depth m (>= 1) before ``dd.realize()``: mirror
+        the domain's deterministic mesh/shard computation, require even
+        (unpadded) shards, and fit ``temporal_k`` ("auto": the deepest m whose
+        ring fits the VMEM budget) within the shard extents."""
+        import jax
+
+        from stencil_tpu.ops.jacobi_pallas import (
+            _WRAP_MAX_K,
+            _WRAP_VMEM_BUDGET,
+            warn_if_over_vmem_budget,
+            wavefront_vmem_bytes,
+        )
+        from stencil_tpu.parallel.mesh import make_mesh
+
+        dd = self.dd
+        if dd.halo_multiplier() != 1:
+            raise ValueError("pallas_path='wavefront' manages the halo multiplier itself")
+        devices = list(dd._devices) if dd._devices is not None else jax.devices()
+        _, placement = make_mesh(
+            dd._size, dd._radius, devices, dd._strategy, force_dim=dd._force_dim
+        )
+        dim = placement.dim()
+        n = [-(-dd._size[ax] // dim[ax]) for ax in range(3)]
+        if any(dd._size[ax] != n[ax] * dim[ax] for ax in range(3)):
+            raise ValueError(
+                "pallas_path='wavefront' requires even (unpadded) sizes; "
+                f"{tuple(dd._size)} over mesh {tuple(dim)} pads"
+            )
+        n_min = min(n)
+        itemsize = self.h.dtype.itemsize
+        if self.temporal_k != "auto":
+            m = int(self.temporal_k)
+            if not 1 <= m <= n_min:
+                raise ValueError(f"wavefront temporal_k={m} needs 1 <= m <= min(shard)={n_min}")
+            warn_if_over_vmem_budget(m, n[1] + 2 * m, n[2] + 2 * m, itemsize)
+            return m
+        m = 1
+        # n_min//4 caps the redundant shell traffic: a depth-m macro step
+        # exchanges ~6*m*n^2 extra cells against m*n^3 of compute, so keep
+        # the shell a small fraction of the shard
+        depth_cap = min(_WRAP_MAX_K, max(1, n_min // 4))
+        for cand in range(2, depth_cap + 1):
+            if wavefront_vmem_bytes(
+                cand, n[1] + 2 * cand, n[2] + 2 * cand, itemsize
+            ) <= _WRAP_VMEM_BUDGET:
+                m = cand
+        return m
+
+    def _make_wavefront_step(self):
+        """Temporally-blocked multi-device step: one m-wide shell exchange
+        feeds an m-level wavefront kernel (``jacobi_shell_wavefront_step``) —
+        ~8/m HBM bytes per cell per iteration, the multi-device counterpart
+        of the wrap path's temporal blocking.  A steps%m remainder runs one
+        shallower wavefront over the same shell."""
+        from functools import partial
+
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from stencil_tpu.ops.exchange import halo_exchange_shard
+        from stencil_tpu.ops.jacobi_pallas import (
+            jacobi_shell_wavefront_step,
+            yz_dist2_plane,
+        )
+        from stencil_tpu.parallel.mesh import MESH_AXES
+
+        dd = self.dd
+        m = self._wavefront_m
+        n = dd.local_spec().sz
+        shell = dd._shell_radius
+        mesh_shape = tuple(dd.mesh.shape[a] for a in MESH_AXES)
+        gsize = tuple(dd.size())
+        raw = dd.local_spec().raw_size()
+        interpret = self.interpret
+        name = self.h.name
+        self._marks_shell_stale = True
+        self._pallas_path = "wavefront"
+
+        def per_shard(steps, raw_block):
+            origin = jnp.stack(
+                [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
+            )
+            yz_d2 = yz_dist2_plane(origin[1] - m, origin[2] - m, (raw.y, raw.z), gsize)
+
+            def macro(depth, b):
+                b = halo_exchange_shard(b, shell, mesh_shape)
+                return jacobi_shell_wavefront_step(
+                    b, depth, origin, yz_d2, gsize, interior_offset=m,
+                    interpret=interpret,
+                )
+
+            macros, rem = divmod(steps, m)
+            b = lax.fori_loop(0, macros, lambda _, b: macro(m, b), raw_block)
+            if rem:
+                b = macro(rem, b)
+            return b
+
+        spec = P(*MESH_AXES)
+
+        @partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def step(curr, steps: int = 1):
+            # check_vma off: pallas_call outputs carry no vma annotation
+            fn = jax.shard_map(
+                partial(per_shard, steps),
+                mesh=dd.mesh,
+                in_specs=(spec,),
+                out_specs=spec,
+                check_vma=False,
+            )
+            return {name: fn(curr[name])}
+
+        return step
 
     def _make_pallas_step(self):
         """Fused exchange + plane-streaming pallas kernel (ops/jacobi_pallas):
@@ -122,12 +264,19 @@ class Jacobi3D:
         want = self.pallas_path_request
         if want == "wrap" and dd.num_subdomains() != 1:
             raise ValueError("pallas_path='wrap' requires a single subdomain")
+        # the slab kernel's z-column dynamic rotate (pltpu.roll on a (Y, X)
+        # slab) compiles only when the lane extent X is 128-aligned (Mosaic
+        # "unsupported unaligned shape" otherwise — scripts/probe11b at 64^3)
+        slab_aligned = self.interpret or dd.local_spec().sz.x % 128 == 0
         if want == "slab" and (
-            any(v is not None for v in dd._valid_last) or dd.local_spec().sz.x < 2
+            any(v is not None for v in dd._valid_last)
+            or dd.local_spec().sz.x < 2
+            or not slab_aligned
         ):
             raise ValueError(
-                "pallas_path='slab' requires even (unpadded) sizes and >= 2 "
-                "x-planes per shard"
+                "pallas_path='slab' requires even (unpadded) sizes, >= 2 "
+                "x-planes per shard, and a 128-aligned x-extent per shard "
+                "when compiled for TPU"
             )
         if want == "wrap" or (want == "auto" and dd.num_subdomains() == 1):
             # single-device fast path: the periodic wrap folds into the
@@ -173,7 +322,9 @@ class Jacobi3D:
 
             return step
         if want in ("auto", "slab") and (
-            all(v is None for v in dd._valid_last) and dd.local_spec().sz.x >= 2
+            all(v is None for v in dd._valid_last)
+            and dd.local_spec().sz.x >= 2
+            and slab_aligned
         ):
             return self._make_slab_step()
         self._pallas_path = "shell"

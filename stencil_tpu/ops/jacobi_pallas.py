@@ -66,6 +66,25 @@ _WRAP_VMEM_BUDGET = 11_600_000
 _WRAP_MAX_K = 6
 
 
+def wavefront_vmem_bytes(k: int, plane_y: int, plane_z: int, itemsize: int) -> int:
+    """Estimated VMEM footprint of a k-level plane wavefront: 2k ring planes
+    + ~4 pipeline (in/out double-buffer) planes + the resident d2 plane —
+    the model _WRAP_VMEM_BUDGET is calibrated against."""
+    return (2 * k + 5) * plane_y * plane_z * itemsize
+
+
+def warn_if_over_vmem_budget(k: int, plane_y: int, plane_z: int, itemsize: int) -> None:
+    est = wavefront_vmem_bytes(k, plane_y, plane_z, itemsize)
+    if est > _WRAP_VMEM_BUDGET:
+        from stencil_tpu.utils.logging import log_warn
+
+        log_warn(
+            f"temporal depth {k} estimates {est / 1e6:.1f} MB of VMEM "
+            f"(> calibrated {_WRAP_VMEM_BUDGET / 1e6:.1f} MB budget); expect a "
+            "compile failure on real TPU (fine in interpret mode)"
+        )
+
+
 def choose_temporal_k(shape: Tuple[int, int, int], itemsize: int, requested="auto") -> int:
     """Pick the wrap kernel's temporal blocking depth: the deepest k whose
     VMEM footprint fits the calibrated budget (``auto``), or a validated
@@ -76,20 +95,26 @@ def choose_temporal_k(shape: Tuple[int, int, int], itemsize: int, requested="aut
         k = int(requested)
         if not 1 <= k <= max(1, X // 2):
             raise ValueError(f"temporal_k={k} needs 1 <= k <= X//2 = {X // 2}")
-        if (2 * k + 5) * Y * Z * itemsize > _WRAP_VMEM_BUDGET:
-            from stencil_tpu.utils.logging import log_warn
-
-            log_warn(
-                f"temporal_k={k} estimates {(2 * k + 5) * Y * Z * itemsize / 1e6:.1f}"
-                f" MB of VMEM (> calibrated {_WRAP_VMEM_BUDGET / 1e6:.1f} MB budget);"
-                " expect a compile failure on real TPU (fine in interpret mode)"
-            )
+        warn_if_over_vmem_budget(k, Y, Z, itemsize)
         return k
     k = 1
     for cand in range(2, _WRAP_MAX_K + 1):
-        if cand <= X // 2 and (2 * cand + 5) * Y * Z * itemsize <= _WRAP_VMEM_BUDGET:
+        if cand <= X // 2 and wavefront_vmem_bytes(cand, Y, Z, itemsize) <= _WRAP_VMEM_BUDGET:
             k = cand
     return k
+
+
+def _make_roll(interpret: bool):
+    """Interpret-aware plane rotate shared by the streaming kernels: jnp.roll
+    in interpret mode, pltpu.roll (amount normalized into range) compiled."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    def roll(v, amt, axis):
+        if interpret:
+            return jnp.roll(v, amt, axis)
+        return pltpu.roll(v, amt % v.shape[axis], axis)
+
+    return roll
 
 
 def jacobi_wrap_step(
@@ -130,10 +155,7 @@ def jacobi_wrap_step(
     gx = X
     hot_x, cold_x, in_r2 = sphere_params(gx)
 
-    def roll(v, amt, axis):
-        if interpret:
-            return jnp.roll(v, amt, axis)
-        return pltpu.roll(v, amt % v.shape[axis], axis)
+    roll = _make_roll(interpret)
 
     def kernel(in_ref, d2_ref, out_ref, ring):
         # ring[s] holds the two most recent level-s planes (level 0 = input)
@@ -175,6 +197,103 @@ def jacobi_wrap_step(
         scratch_shapes=[pltpu.VMEM((k, 2, Y, Z), block.dtype)],
         interpret=interpret,
     )(block, d2.astype(jnp.int32))
+
+
+def jacobi_shell_wavefront_step(
+    raw: jax.Array,  # (X+2s, Y+2s, Z+2s) block with FILLED s-wide shell, s >= m
+    m: int,  # levels to advance (<= the shell width)
+    origin: jax.Array,  # (3,) int32 global coords of the shard's interior start
+    d2: jax.Array,  # (Y+2s, Z+2s) int32 yz_dist2_plane over the RAW plane
+    global_size: Tuple[int, int, int],
+    interior_offset: int = None,  # raw index of the interior start (= shell
+    # width s; defaults to m — pass it when advancing FEWER levels than the
+    # shell is wide, e.g. a steps%m remainder dispatch)
+    interpret: bool = False,
+) -> jax.Array:
+    """``m`` Jacobi levels over an m-shell-carrying shard in ONE pass — the
+    multi-device temporal-blocking path.
+
+    The halo-multiplier machinery (domain.set_halo_multiplier) already
+    exchanges ``m*r``-wide shells every ``m`` steps; this kernel is its
+    compute half done the wrap-kernel way: a wavefront over time steps where
+    each HBM plane is read once and written once per ``m`` iterations
+    (~8/m B/cell), instead of ``m`` separate full passes.  Validity shrinks
+    exactly one cell per level from each face — the roll wraparound at the
+    y/z plane edges and the missing planes at the x ends contaminate only
+    the cells the shell was sized to sacrifice: level ``s`` is valid on
+    ``[s, ext-s)`` per axis, and the interior ``[m, ext-m)`` is exactly
+    level ``m``'s guarantee.  Unlike ``jacobi_wrap_step`` there is no ring
+    closure, hence no replay: the grid is one step per raw plane.
+
+    The interior lands advanced ``m`` levels; shell cells hold garbage
+    (low-x planes) or their pre-step values (aliased high-x planes) — the
+    caller re-exchanges before the next wavefront and marks the shell stale
+    for readback, so no consumer ever observes them.
+
+    Reference analog: the halo-multiplier idea the reference lists as future
+    work (README.md:157-176 "exchange every k steps"); here it is what makes
+    the multi-GPU pipeline's traffic match the single-device fast path.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Xr, Yr, Zr = raw.shape
+    s_off = m if interior_offset is None else interior_offset
+    # raw must carry a shell at least m wide plus >= 1 interior cell per axis
+    assert 1 <= m <= s_off and 2 * s_off < min(Xr, Yr, Zr), (m, s_off, raw.shape)
+    gx = global_size[0]
+    hot_x, cold_x, in_r2 = sphere_params(gx)
+
+    roll = _make_roll(interpret)
+
+    def kernel(origin_ref, in_ref, d2_ref, out_ref, ring):
+        # ring[s] holds the two most recent level-s planes (level 0 = input)
+        i = pl.program_id(0)
+        d2v = d2_ref[...]
+        vals = in_ref[0]  # level-0 raw plane i
+        for s in range(1, m + 1):
+            prev = ring[s - 1, i % 2]  # level-(s-1) plane i-s-1
+            cent = ring[s - 1, (i + 1) % 2]  # level-(s-1) plane i-s
+            ring[s - 1, i % 2] = vals  # push plane i-s+1 (after prev read)
+            val = (
+                prev
+                + vals
+                + roll(cent, 1, 0)
+                + roll(cent, -1, 0)
+                + roll(cent, 1, 1)
+                + roll(cent, -1, 1)
+            ) / 6.0
+            # global x of level-s plane i-s (raw index -> interior-origin
+            # coords; + gx keeps lax.rem's operand non-negative:
+            # i-s-s_off >= -2*s_off > -gx).  Shell planes matter too: their
+            # intermediate-level values feed valid higher-level cells, so
+            # forcing must follow the periodic global coordinate everywhere.
+            x_g = jax.lax.rem(
+                origin_ref[0] + jnp.int32(gx) + i - jnp.int32(s + s_off), jnp.int32(gx)
+            )
+            val = jnp.where(d2v < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
+            val = jnp.where(d2v < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
+            vals = val.astype(vals.dtype)
+        out_ref[0] = vals  # level-m plane i-m; valid for interior planes
+
+    return pl.pallas_call(
+        kernel,
+        grid=(Xr,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, Yr, Zr), lambda i: (i, 0, 0)),
+            # constant index map: fetched once, stays resident in VMEM
+            pl.BlockSpec((Yr, Zr), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Yr, Zr), lambda i: (jnp.maximum(i - m, 0), 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Xr, Yr, Zr), raw.dtype),
+        # in-place: the write of plane i-m trails the fetch of plane i+1 by
+        # m+1 planes, so aliasing is hazard-free; unwritten high-shell planes
+        # keep their pre-step bytes
+        input_output_aliases={1: 0},
+        scratch_shapes=[pltpu.VMEM((m, 2, Yr, Zr), raw.dtype)],
+        interpret=interpret,
+    )(origin.astype(jnp.int32), raw, d2.astype(jnp.int32))
 
 
 def jacobi_slab_step(
@@ -226,10 +345,7 @@ def jacobi_slab_step(
     gx = global_size[0]
     hot_x, cold_x, in_r2 = sphere_params(gx)
 
-    def roll(v, amt, axis):
-        if interpret:
-            return jnp.roll(v, amt, axis)
-        return pltpu.roll(v, amt % v.shape[axis], axis)
+    roll = _make_roll(interpret)
 
     def kernel(
         origin_ref, in_ref, xlo_ref, xhi_ref, ylo_ref, yhi_ref, zlo_ref, zhi_ref,

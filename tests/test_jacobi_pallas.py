@@ -72,6 +72,67 @@ def test_wrap_temporal_blocking_model_with_remainder():
     np.testing.assert_array_equal(a.temperature(), b.temperature())
 
 
+@pytest.mark.parametrize("size", [(24, 24, 24), (16, 24, 32)])
+def test_wavefront_matches_jnp_multidevice(size):
+    """The temporally-blocked multi-device path (m-shell exchange + m-level
+    wavefront kernel) equals the generic jnp formulation, including a
+    steps % m remainder dispatch."""
+    a = Jacobi3D(*size)
+    a.realize()
+    b = Jacobi3D(*size, kernel_impl="pallas", interpret=True, pallas_path="wavefront")
+    b.realize()
+    assert b._pallas_path == "wavefront"
+    assert b._wavefront_m >= 2
+    a.step(5)
+    b.step(5)  # 5 = 2 macros of m=2 + rem 1, or 1 macro of m>=3 + rem
+    np.testing.assert_allclose(a.temperature(), b.temperature(), rtol=1e-6)
+
+
+def test_wavefront_bit_exact_vs_wrap_single_device():
+    """At mesh [1,1,1] the self-permuted shell is the periodic wrap, and the
+    wavefront kernel's summation order matches the wrap kernel's — the two
+    paths must agree bitwise."""
+    dev = jax.devices()[:1]
+    a = Jacobi3D(20, 18, 22, kernel_impl="pallas", interpret=True, devices=dev,
+                 temporal_k=3)
+    a.realize()
+    assert a._pallas_path == "wrap"
+    b = Jacobi3D(20, 18, 22, kernel_impl="pallas", interpret=True, devices=dev,
+                 pallas_path="wavefront", temporal_k=3)
+    b.realize()
+    assert b._pallas_path == "wavefront" and b._wavefront_m == 3
+    a.step(6)
+    b.step(6)
+    np.testing.assert_array_equal(a.temperature(), b.temperature())
+
+
+def test_auto_routes_multidevice_to_wavefront():
+    """Even multi-device sizes default to the temporally-blocked wavefront
+    (probe11: 1.8x the slab route on hardware); uneven falls back to shell."""
+    m = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=True)
+    m.realize()
+    assert m._pallas_path == "wavefront" and m._wavefront_m >= 2
+    u = Jacobi3D(15, 16, 16, kernel_impl="pallas", interpret=True)
+    u.realize()
+    assert u._pallas_path == "shell"
+
+
+def test_slab_forced_rejects_unaligned_x_on_tpu(monkeypatch):
+    """Forced slab with interpret=False must reject a non-128-aligned shard
+    x-extent (the z-column dynamic rotate limit, probe11b)."""
+    m = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=False,
+                 pallas_path="slab")
+    with pytest.raises(ValueError, match="128-aligned"):
+        m.realize()
+
+
+def test_wavefront_rejects_uneven():
+    with pytest.raises(ValueError, match="even"):
+        m = Jacobi3D(15, 16, 16, kernel_impl="pallas", interpret=True,
+                     pallas_path="wavefront")
+        m.realize()
+
+
 def test_choose_temporal_k():
     from stencil_tpu.ops.jacobi_pallas import choose_temporal_k
 

@@ -66,8 +66,10 @@ def test_slab_step_requires_two_planes():
 
 
 def test_model_routes_slab_multidevice():
-    """Even sizes on the 8-device mesh must take the slab path."""
-    m = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=True)
+    """Forced slab on even sizes on the 8-device mesh engages (auto now
+    prefers the temporally-blocked wavefront route)."""
+    m = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=True,
+                 pallas_path="slab")
     m.realize()
     assert m.dd.num_subdomains() == len(jax.devices())
     assert m._pallas_path == "slab"
@@ -83,7 +85,8 @@ def test_model_routes_shell_when_uneven():
 def test_slab_model_matches_jnp(size):
     a = Jacobi3D(*size)
     a.realize()
-    b = Jacobi3D(*size, kernel_impl="pallas", interpret=True)
+    b = Jacobi3D(*size, kernel_impl="pallas", interpret=True,
+                 pallas_path="slab")
     b.realize()
     assert b._pallas_path == "slab"
     a.step(4)

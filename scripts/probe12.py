@@ -1,0 +1,99 @@
+"""Probe: where do the wavefront macro's ~2 ms/iter of overhead go?
+
+Time, at 512^3 m=2 on one chip: (a) jacobi_wrap_step k=2 (baseline, separate
+in/out buffers), (b) bare jacobi_shell_wavefront_step with aliasing, (c) the
+same without aliasing, (d) the full wavefront model step (exchange+kernel).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+from stencil_tpu.ops.jacobi_pallas import (
+    jacobi_shell_wavefront_step,
+    jacobi_wrap_step,
+    yz_dist2_plane,
+)
+
+N = 512
+M = 2
+STEPS = 48  # macro steps per dispatch
+
+
+def bench(name, fn, state, rt, per_macro_iters):
+    def go(n):
+        state["a"] = fn(state["a"], n * STEPS)
+        float(jnp.sum(state["a"][0, 0, 0:1]))
+
+    samples, _ = timed_inner_loop(go, 1, rt, 3)
+    t = min(samples) / STEPS / per_macro_iters
+    print(f"{name}: {t*1e3:.3f} ms/iter  {N**3/t/1e9:.1f} Gcells/s", flush=True)
+
+
+def main():
+    rt = host_round_trip_s()
+    print(f"host rt: {rt*1e3:.1f} ms", flush=True)
+    key = jax.random.PRNGKey(0)
+
+    # (a) wrap k=2 baseline
+    a = jax.random.uniform(key, (N, N, N), jnp.float32)
+
+    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def wrap_loop(b, s):
+        return lax.fori_loop(0, s, lambda _, x: jacobi_wrap_step(x, k=M), b)
+
+    bench("wrap k=2", wrap_loop, {"a": a}, rt, M)
+
+    # (b)/(c) bare wavefront kernel, raw block with shell
+    raw_np = np.asarray(
+        jax.random.uniform(key, (N + 2 * M, N + 2 * M, N + 2 * M), jnp.float32)
+    )
+    origin = jnp.zeros((3,), jnp.int32)
+    d2 = yz_dist2_plane(-M, -M, (N + 2 * M, N + 2 * M), (N, N, N)).astype(jnp.int32)
+
+    for alias in (True, False):
+        raw = jnp.asarray(raw_np)  # fresh buffer (the loop donates its input)
+
+        @partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)
+        def wf_loop(b, s, alias):
+            return lax.fori_loop(
+                0,
+                s,
+                lambda _, x: jacobi_shell_wavefront_step(
+                    x, M, origin, d2, (N, N, N), alias=alias
+                ),
+                b,
+            )
+
+        fn = partial(wf_loop, alias=alias)
+        bench(f"wavefront bare alias={alias}", fn, {"a": raw}, rt, M)
+
+    # (d) full model step for reference
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    model = Jacobi3D(N, N, N, devices=jax.devices()[:1], kernel_impl="pallas",
+                     pallas_path="wavefront")
+    model.realize()
+
+    def model_fn(_, s):
+        model.step(s * M)
+        return _
+
+    def go(n):
+        model.step(n * STEPS * M)
+        float(jnp.sum(model.dd.get_curr(model.h)))
+
+    samples, _ = timed_inner_loop(go, 1, rt, 3)
+    t = min(samples) / STEPS / M
+    print(f"model wavefront m={model._wavefront_m}: {t*1e3:.3f} ms/iter  "
+          f"{N**3/t/1e9:.1f} Gcells/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

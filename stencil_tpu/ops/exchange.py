@@ -177,6 +177,23 @@ def halo_exchange_multi(
         if uneven:
             idx = lax.axis_index(name)
             n_valid = jnp.where(idx == n_dev - 1, v_last, n_pad).astype(jnp.int32)
+
+        def through_permute(slabs, shift_fn):
+            if axis != 0:
+                return _fused_shift(slabs, shift_fn, name, n_dev)
+            # axis-0 slabs (r, Y, Z) travel as (1, r*Y, Z): the slice is
+            # contiguous, and the 2D-spatial buffer keeps XLA's layout
+            # assignment from giving the permute operand a transposed layout
+            # whose feeder is a full-domain relayout copy (seen as a ~3 ms
+            # {2,1,0}->{2,0,1} copy per macro step in the wavefront loop)
+            shapes = [s.shape for s in slabs]
+            flat = [
+                s.reshape(s.shape[:-3] + (1, s.shape[-3] * s.shape[-2], s.shape[-1]))
+                for s in slabs
+            ]
+            out = _fused_shift(flat, shift_fn, name, n_dev)
+            return [o.reshape(sh) for o, sh in zip(out, shapes)]
+
         lo_recv = hi_recv = None
         if r_lo > 0:
             # my low halo [0, r_lo) <- -axis neighbor's top slab of VALID
@@ -189,22 +206,20 @@ def halo_exchange_multi(
                 else b[axslice(b, n_pad, r_lo + n_pad)]
                 for b in blocks
             ]
-            lo_recv = _fused_shift(slabs, _shift_from_low, name, n_dev)
+            lo_recv = through_permute(slabs, _shift_from_low)
         if r_hi > 0:
             # my high halo <- +axis neighbor's interior bottom slab, width
             # r_hi, written right after MY valid cells
             slabs = [b[axslice(b, r_lo, r_lo + r_hi)] for b in blocks]
-            hi_recv = _fused_shift(slabs, _shift_from_high, name, n_dev)
+            hi_recv = through_permute(slabs, _shift_from_high)
         # y/z halo writes go through tile-local pallas blend kernels where
         # possible: plain DUS slivers on those axes bait XLA's layout
         # assignment into transposing the whole array (two full-domain
         # relayout copies per exchange — see ops/halo_blend.py).
         from stencil_tpu.ops import halo_blend
 
-        blend = (
-            axis != 0
-            and halo_blend.enabled()
-            and all(b.ndim == 3 and halo_blend.supports(b.dtype) for b in blocks)
+        blend = halo_blend.enabled() and all(
+            b.ndim == 3 and halo_blend.supports(b.dtype) for b in blocks
         )
         interp = halo_blend.interpret_mode()
         for j, b in enumerate(blocks):
@@ -216,11 +231,12 @@ def halo_exchange_multi(
                 else:
                     b = b.at[axslice(b, 0, r_lo)].set(lo_recv[j])
             if hi_recv is not None:
-                if uneven and blend:
+                if uneven and blend and axis != 0:
                     b = halo_blend.blend_slab_dynamic(
                         b, hi_recv[j], axis, r_lo + n_valid, interpret=interp
                     )
                 elif uneven:
+                    # axis-0 traced offset: plane DUS is contiguous, no trap
                     b = lax.dynamic_update_slice(
                         b, hi_recv[j], dyn_starts(b, r_lo + n_valid)
                     )

@@ -209,6 +209,8 @@ def jacobi_shell_wavefront_step(
     # width s; defaults to m — pass it when advancing FEWER levels than the
     # shell is wide, e.g. a steps%m remainder dispatch)
     interpret: bool = False,
+    alias: bool = True,  # in-place (input_output_aliases); False trades the
+    # aliasing for a fresh output buffer (uninitialized high shell)
 ) -> jax.Array:
     """``m`` Jacobi levels over an m-shell-carrying shard in ONE pass — the
     multi-device temporal-blocking path.
@@ -290,7 +292,7 @@ def jacobi_shell_wavefront_step(
         # in-place: the write of plane i-m trails the fetch of plane i+1 by
         # m+1 planes, so aliasing is hazard-free; unwritten high-shell planes
         # keep their pre-step bytes
-        input_output_aliases={1: 0},
+        input_output_aliases={1: 0} if alias else {},
         scratch_shapes=[pltpu.VMEM((m, 2, Yr, Zr), raw.dtype)],
         interpret=interpret,
     )(origin.astype(jnp.int32), raw, d2.astype(jnp.int32))

@@ -11,12 +11,14 @@ import pytest
 from stencil_tpu.ops.halo_blend import blend_slab
 
 
-@pytest.mark.parametrize("axis", [1, 2])
+@pytest.mark.parametrize("axis", [0, 1, 2])
 @pytest.mark.parametrize("pos_kind", ["lo", "hi"])
 @pytest.mark.parametrize("r", [1, 3, 9])
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_blend_equals_dus(axis, pos_kind, r, dtype):
     shape = (6, 21, 19)
+    if r > shape[axis]:
+        pytest.skip("slab wider than the axis")
     rng = np.random.default_rng(0)
     block = jnp.asarray(rng.random(shape), dtype=dtype)
     slab_shape = list(shape)

@@ -85,3 +85,30 @@ def test_exchange_fn_4_quantities_6_permutes():
     txt = dd._exchange_fn.lower(dd._curr).compile().as_text()
     n = len(re.findall(r"collective-permute", txt))
     assert 1 <= n <= 6, n
+
+
+def test_exchange_permutes_carry_fused_multi_quantity_sizes():
+    """Pin not just the message COUNT but the fused payload SHAPES: each of
+    the 6 permutes must carry all 4 quantities stacked into one buffer of
+    exactly the sweep-slab size (the reference's packed per-direction buffer,
+    packer.cuh:52-69).  28^3 over mesh [2,2,2], radius 3: shard 14^3, raw
+    20^3, so y-slabs are [4,20,3,20], z [4,20,20,3]; x-slabs (3,20,20) ride
+    flattened as [4,1,60,20] (layout-friendly 2D-spatial form)."""
+    import jax.numpy as jnp
+
+    from stencil_tpu.domain import DistributedDomain
+
+    dd = DistributedDomain(28, 28, 28)
+    dd.set_radius(3)
+    for i in range(4):
+        dd.add_data(f"q{i}", jnp.float32)
+    dd.realize()
+    assert tuple(dd.placement.dim()) == (2, 2, 2)
+    txt = dd._exchange_fn.lower(dd._curr).compile().as_text()
+    # CPU lowering prints each permute as `%... = f32[SHAPE]... collective-permute(...`
+    shapes = sorted(
+        re.findall(r"= f32\[([\d,]+)\]\S* collective-permute\(", txt)
+    )
+    assert shapes == sorted(
+        ["4,1,60,20", "4,1,60,20", "4,20,3,20", "4,20,3,20", "4,20,20,3", "4,20,20,3"]
+    ), shapes

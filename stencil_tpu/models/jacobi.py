@@ -115,15 +115,17 @@ class Jacobi3D:
     def _plan_wavefront(self) -> int:
         """Choose the wavefront depth m (>= 1) before ``dd.realize()``: mirror
         the domain's deterministic mesh/shard computation, require even
-        (unpadded) shards, and fit ``temporal_k`` ("auto": the deepest m whose
-        ring fits the VMEM budget) within the shard extents."""
+        (unpadded) shards, and fit ``temporal_k`` ("auto") within the shard
+        extents and the modeled VMEM limit.  Prefers the z-slab kernel
+        variant (z halos never touch the tiled array) and records the choice
+        in ``self._wavefront_z_planned``; when even depth 2 with slabs does
+        not fit, falls back to the plain variant at its own deepest m."""
         import jax
 
         from stencil_tpu.ops.jacobi_pallas import (
             _WRAP_MAX_K,
-            _WRAP_VMEM_BUDGET,
             warn_if_over_vmem_budget,
-            wavefront_vmem_bytes,
+            wavefront_vmem_fits,
         )
         from stencil_tpu.parallel.mesh import make_mesh
 
@@ -143,37 +145,62 @@ class Jacobi3D:
             )
         n_min = min(n)
         itemsize = self.h.dtype.itemsize
+
+        def fits(m, z):
+            return wavefront_vmem_fits(
+                m, n[1] + 2 * m, n[2] + 2 * m, itemsize, z_slabs=z
+            )
+
         if self.temporal_k != "auto":
             m = int(self.temporal_k)
             if not 1 <= m <= n_min:
                 raise ValueError(f"wavefront temporal_k={m} needs 1 <= m <= min(shard)={n_min}")
             warn_if_over_vmem_budget(m, n[1] + 2 * m, n[2] + 2 * m, itemsize)
+            self._wavefront_z_planned = fits(m, True)
             return m
-        m = 1
         # n_min//4 caps the redundant shell traffic: a depth-m macro step
         # exchanges ~6*m*n^2 extra cells against m*n^3 of compute, so keep
         # the shell a small fraction of the shard
         depth_cap = min(_WRAP_MAX_K, max(1, n_min // 4))
-        for cand in range(2, depth_cap + 1):
-            if wavefront_vmem_bytes(
-                cand, n[1] + 2 * cand, n[2] + 2 * cand, itemsize
-            ) <= _WRAP_VMEM_BUDGET:
-                m = cand
-        return m
+        for z_mode in (True, False):
+            m = 1 if not z_mode else 0
+            for cand in range(2, depth_cap + 1):
+                if fits(cand, z_mode):
+                    m = cand
+            if m >= 2 or not z_mode:
+                self._wavefront_z_planned = z_mode and m >= 2
+                return max(m, 1)
+        raise AssertionError("unreachable: z_mode=False always returns")
 
     def _make_wavefront_step(self):
         """Temporally-blocked multi-device step: one m-wide shell exchange
         feeds an m-level wavefront kernel (``jacobi_shell_wavefront_step``) —
         ~8/m HBM bytes per cell per iteration, the multi-device counterpart
         of the wrap path's temporal blocking.  A steps%m remainder runs one
-        shallower wavefront over the same shell."""
+        shallower wavefront over the same shell.
+
+        The z halos never touch the big array (``STENCIL_Z_SLABS=0``
+        disables): a z-halo read or write on the tiled layout rewrites whole
+        (8,128)-tile columns (~a full-domain pass per exchange, probe12d),
+        so the z-shell lives in separate (Xr, Yr, m) slab arrays that the
+        kernel consumes (VMEM column patching) and emits (next macro's
+        outgoing slabs).  Corner data propagates on the slabs themselves:
+        after the z ppermute, each slab is extended with rows from the y
+        neighbors and then planes from the x neighbors (two hops carry the
+        xyz-corner cells from the diagonal blocks), mirroring the sweep
+        order of the in-array exchange."""
+        import os
         from functools import partial
 
         import jax
         from jax import lax
         from jax.sharding import PartitionSpec as P
 
-        from stencil_tpu.ops.exchange import halo_exchange_shard
+        from stencil_tpu.ops.exchange import (
+            _shift_from_high,
+            _shift_from_low,
+            halo_exchange_shard,
+        )
         from stencil_tpu.ops.jacobi_pallas import (
             jacobi_shell_wavefront_step,
             yz_dist2_plane,
@@ -189,8 +216,14 @@ class Jacobi3D:
         raw = dd.local_spec().raw_size()
         interpret = self.interpret
         name = self.h.name
+        z_slab_mode = (
+            os.environ.get("STENCIL_Z_SLABS", "1") != "0"
+            and getattr(self, "_wavefront_z_planned", False)
+        )
         self._marks_shell_stale = True
         self._pallas_path = "wavefront"
+        self._wavefront_z_slabs = z_slab_mode
+        Xr, Yr, Zr = raw.x, raw.y, raw.z
 
         def per_shard(steps, raw_block):
             origin = jnp.stack(
@@ -198,18 +231,59 @@ class Jacobi3D:
             )
             yz_d2 = yz_dist2_plane(origin[1] - m, origin[2] - m, (raw.y, raw.z), gsize)
 
-            def macro(depth, b):
-                b = halo_exchange_shard(b, shell, mesh_shape)
+            if not z_slab_mode:
+                def macro_plain(depth, b):
+                    b = halo_exchange_shard(b, shell, mesh_shape)
+                    return jacobi_shell_wavefront_step(
+                        b, depth, origin, yz_d2, gsize, interior_offset=m,
+                        interpret=interpret,
+                    )
+
+                macros, rem = divmod(steps, m)
+                b = lax.fori_loop(0, macros, lambda _, b: macro_plain(m, b), raw_block)
+                if rem:
+                    b = macro_plain(rem, b)
+                return b
+
+            def yext(S):
+                # my slab's y-shell rows hold the y neighbors' top/bottom
+                # interior rows of the SAME slab (post z-permute, so the
+                # yz-diagonal's data is already aboard)
+                lo = _shift_from_low(S[:, Yr - 2 * m : Yr - m, :], MESH_AXES[1], mesh_shape[1])
+                hi = _shift_from_high(S[:, m : 2 * m, :], MESH_AXES[1], mesh_shape[1])
+                return S.at[:, 0:m, :].set(lo).at[:, Yr - m : Yr, :].set(hi)
+
+            def xext(S):
+                lo = _shift_from_low(S[Xr - 2 * m : Xr - m], MESH_AXES[0], mesh_shape[0])
+                hi = _shift_from_high(S[m : 2 * m], MESH_AXES[0], mesh_shape[0])
+                return S.at[0:m].set(lo).at[Xr - m : Xr].set(hi)
+
+            def macro(depth, carry):
+                b, ztop, zbot = carry
+                # x/y shells in the array (cheap: planes / sublane rows)
+                b = halo_exchange_shard(b, shell, mesh_shape, axes=(0, 1))
+                zlo = _shift_from_low(ztop, MESH_AXES[2], mesh_shape[2])
+                zhi = _shift_from_high(zbot, MESH_AXES[2], mesh_shape[2])
+                zlo = xext(yext(zlo))
+                zhi = xext(yext(zhi))
                 return jacobi_shell_wavefront_step(
                     b, depth, origin, yz_d2, gsize, interior_offset=m,
-                    interpret=interpret,
+                    z_slabs=(zlo, zhi), interpret=interpret,
                 )
 
+            # prime the slab carry from the block's interior z boundaries
+            # (the one strided z read per dispatch; all later slabs are
+            # kernel-emitted)
+            carry = (
+                raw_block,
+                raw_block[:, :, Zr - 2 * m : Zr - m],
+                raw_block[:, :, m : 2 * m],
+            )
             macros, rem = divmod(steps, m)
-            b = lax.fori_loop(0, macros, lambda _, b: macro(m, b), raw_block)
+            carry = lax.fori_loop(0, macros, lambda _, c: macro(m, c), carry)
             if rem:
-                b = macro(rem, b)
-            return b
+                carry = macro(rem, carry)
+            return carry[0]
 
         spec = P(*MESH_AXES)
 

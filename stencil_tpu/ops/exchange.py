@@ -119,6 +119,7 @@ def halo_exchange_multi(
     mesh_shape: Tuple[int, int, int],
     axis_names: Sequence[str] = MESH_AXES,
     valid_last: Optional[Tuple[Optional[int], Optional[int], Optional[int]]] = None,
+    axes: Tuple[int, ...] = (0, 1, 2),
 ) -> List[jax.Array]:
     """Fill the halo shells of several shell-carrying shards JOINTLY —
     ≤ 2 ppermutes per axis sweep (≤ 6 total) no matter how many quantities,
@@ -147,7 +148,7 @@ def halo_exchange_multi(
             "all quantities must share one spatial (last-3-dims) shape; got "
             f"{[b.shape for b in blocks]}"
         )
-    for axis in range(3):
+    for axis in axes:
         r_lo = radius.axis(axis, -1)  # my low-side halo width
         r_hi = radius.axis(axis, +1)  # my high-side halo width
         if r_lo == 0 and r_hi == 0:
@@ -256,9 +257,12 @@ def halo_exchange_shard(
     mesh_shape: Tuple[int, int, int],
     axis_names: Sequence[str] = MESH_AXES,
     valid_last: Optional[Tuple[Optional[int], Optional[int], Optional[int]]] = None,
+    axes: Tuple[int, ...] = (0, 1, 2),
 ) -> jax.Array:
     """Single-quantity convenience wrapper over ``halo_exchange_multi``."""
-    return halo_exchange_multi([block], radius, mesh_shape, axis_names, valid_last)[0]
+    return halo_exchange_multi(
+        [block], radius, mesh_shape, axis_names, valid_last, axes=axes
+    )[0]
 
 
 def make_exchange_fn_allgather(mesh: Mesh, radius: Radius, spec, dim):

@@ -54,11 +54,14 @@ def yz_dist2_plane(origin_y, origin_z, shape_yz: Tuple[int, int], global_size) -
     return ((y - cy) ** 2)[:, None] + ((z - cz) ** 2)[None, :]
 
 
-#: VMEM the auto-chosen temporal blocking depth may claim: 2k ring planes +
-#: ~4 pipeline (in/out double-buffer) planes + the resident d2 plane.
-#: Calibrated on v5e (scripts/probe10/probe10b): 512^2-plane k=3 (11.5 MB
-#: estimated) compiles and runs; k=4 (13.6 MB) is rejected by the compiler.
-_WRAP_VMEM_BUDGET = 11_600_000
+#: the TPU scoped-VMEM hard limit the compiler enforces per kernel, and the
+#: stack margin its temporaries (rolls, selects) claim beyond the block
+#: buffers.  Calibrated against eight observed compile pass/fail points
+#: (probe10/10b/14/14b, v5e): e.g. wrap 512^2-plane k=3 passes (14.5 MB
+#: modeled), k=4 fails (16.6); wavefront 516^2-plane m=2 passes (15.0),
+#: +z-slabs fails at a REPORTED 17.08 MB vs 17.11 modeled.
+_VMEM_LIMIT = 16_000_000
+_VMEM_STACK_MARGIN = 3_000_000
 
 #: deepest depth validated on hardware; beyond it each level adds < 5%
 #: (probe10b: 256^3 k=6 134.0 -> k=8 135.2 Gcells/s) so there is no hurry to
@@ -66,22 +69,43 @@ _WRAP_VMEM_BUDGET = 11_600_000
 _WRAP_MAX_K = 6
 
 
-def wavefront_vmem_bytes(k: int, plane_y: int, plane_z: int, itemsize: int) -> int:
-    """Estimated VMEM footprint of a k-level plane wavefront: 2k ring planes
-    + ~4 pipeline (in/out double-buffer) planes + the resident d2 plane —
-    the model _WRAP_VMEM_BUDGET is calibrated against."""
-    return (2 * k + 5) * plane_y * plane_z * itemsize
+def _padded_plane_bytes(plane_y: int, plane_z: int, itemsize: int) -> int:
+    """HBM/VMEM bytes of one (plane_y, plane_z) plane after (sublane, 128)
+    tile padding — lane padding is what the naive y*z*itemsize model misses
+    (516 lanes really occupy 640)."""
+    sub = max(8, 32 // itemsize)  # f32 -> 8, bf16 -> 16, i8 -> 32
+    return (-(-plane_y // sub) * sub) * (-(-plane_z // 128) * 128) * itemsize
+
+
+def wavefront_vmem_bytes(
+    k: int, plane_y: int, plane_z: int, itemsize: int, z_slabs: bool = False
+) -> int:
+    """Modeled VMEM footprint of a k-level plane wavefront: 2k ring planes,
+    4 pipeline (in/out double-buffer) planes, the resident int32 d2 plane,
+    and (z-slab variant) 8 double-buffered slab blocks."""
+    plane = _padded_plane_bytes(plane_y, plane_z, itemsize)
+    est = (2 * k + 4) * plane + _padded_plane_bytes(plane_y, plane_z, 4)
+    if z_slabs:
+        est += 8 * _padded_plane_bytes(plane_y, 1, itemsize)
+    return est
+
+
+def wavefront_vmem_fits(
+    k: int, plane_y: int, plane_z: int, itemsize: int, z_slabs: bool = False
+) -> bool:
+    est = wavefront_vmem_bytes(k, plane_y, plane_z, itemsize, z_slabs)
+    return est + _VMEM_STACK_MARGIN <= _VMEM_LIMIT
 
 
 def warn_if_over_vmem_budget(k: int, plane_y: int, plane_z: int, itemsize: int) -> None:
-    est = wavefront_vmem_bytes(k, plane_y, plane_z, itemsize)
-    if est > _WRAP_VMEM_BUDGET:
+    if not wavefront_vmem_fits(k, plane_y, plane_z, itemsize):
+        est = wavefront_vmem_bytes(k, plane_y, plane_z, itemsize)
         from stencil_tpu.utils.logging import log_warn
 
         log_warn(
-            f"temporal depth {k} estimates {est / 1e6:.1f} MB of VMEM "
-            f"(> calibrated {_WRAP_VMEM_BUDGET / 1e6:.1f} MB budget); expect a "
-            "compile failure on real TPU (fine in interpret mode)"
+            f"temporal depth {k} models {est / 1e6:.1f} MB of VMEM blocks "
+            f"(+{_VMEM_STACK_MARGIN / 1e6:.0f} stack > {_VMEM_LIMIT / 1e6:.0f} limit); "
+            "expect a compile failure on real TPU (fine in interpret mode)"
         )
 
 
@@ -99,7 +123,7 @@ def choose_temporal_k(shape: Tuple[int, int, int], itemsize: int, requested="aut
         return k
     k = 1
     for cand in range(2, _WRAP_MAX_K + 1):
-        if cand <= X // 2 and wavefront_vmem_bytes(cand, Y, Z, itemsize) <= _WRAP_VMEM_BUDGET:
+        if cand <= X // 2 and wavefront_vmem_fits(cand, Y, Z, itemsize):
             k = cand
     return k
 
@@ -211,6 +235,14 @@ def jacobi_shell_wavefront_step(
     interpret: bool = False,
     alias: bool = True,  # in-place (input_output_aliases); False trades the
     # aliasing for a fresh output buffer (uninitialized high shell)
+    z_slabs: Tuple[jax.Array, jax.Array] = None,  # (zlo, zhi), each
+    # (Xr, Yr, s) with s = the shell width: the z-halo content, kept OUT of
+    # the big array (a z halo write/read on the tiled layout costs a whole
+    # (8,128)-tile column pass, ~64x amplification — scripts/probe12d); the
+    # kernel patches the z columns of every streamed plane in VMEM instead
+    # and, when set, ALSO emits the next macro step's outgoing z slabs
+    # (my interior z-boundary columns at the output level), returning
+    # (out, z_top, z_bot) with z_top = cols [Zr-2s, Zr-s), z_bot = [s, 2s).
 ) -> jax.Array:
     """``m`` Jacobi levels over an m-shell-carrying shard in ONE pass — the
     multi-device temporal-blocking path.
@@ -248,11 +280,24 @@ def jacobi_shell_wavefront_step(
 
     roll = _make_roll(interpret)
 
-    def kernel(origin_ref, in_ref, d2_ref, out_ref, ring):
+    def kernel(origin_ref, in_ref, d2_ref, *rest):
+        if z_slabs is not None:
+            zlo_ref, zhi_ref, out_ref, ztop_ref, zbot_ref, ring = rest
+        else:
+            out_ref, ring = rest
         # ring[s] holds the two most recent level-s planes (level 0 = input)
         i = pl.program_id(0)
         d2v = d2_ref[...]
         vals = in_ref[0]  # level-0 raw plane i
+        if z_slabs is not None:
+            # patch the z-shell columns in VMEM — they are never stored in
+            # the big array
+            col = jax.lax.broadcasted_iota(jnp.int32, (Yr, Zr), 1)
+            for j in range(s_off):
+                vals = jnp.where(col == j, zlo_ref[0, :, j][:, None], vals)
+                vals = jnp.where(
+                    col == Zr - s_off + j, zhi_ref[0, :, j][:, None], vals
+                )
         for s in range(1, m + 1):
             prev = ring[s - 1, i % 2]  # level-(s-1) plane i-s-1
             cent = ring[s - 1, (i + 1) % 2]  # level-(s-1) plane i-s
@@ -277,25 +322,52 @@ def jacobi_shell_wavefront_step(
             val = jnp.where(d2v < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
             vals = val.astype(vals.dtype)
         out_ref[0] = vals  # level-m plane i-m; valid for interior planes
+        if z_slabs is not None:
+            # emit next macro's outgoing z slabs: my interior z-boundary
+            # columns at the output level (shell planes/rows carry garbage
+            # here; the caller's slab extensions overwrite them)
+            ztop_ref[0] = vals[:, Zr - 2 * s_off : Zr - s_off]
+            zbot_ref[0] = vals[:, s_off : 2 * s_off]
 
+    out_idx = lambda i: (jnp.maximum(i - m, 0), 0, 0)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, Yr, Zr), lambda i: (i, 0, 0)),
+        # constant index map: fetched once, stays resident in VMEM
+        pl.BlockSpec((Yr, Zr), lambda i: (0, 0)),
+    ]
+    out_specs = pl.BlockSpec((1, Yr, Zr), out_idx)
+    out_shape = jax.ShapeDtypeStruct((Xr, Yr, Zr), raw.dtype)
+    args = [origin.astype(jnp.int32), raw, d2.astype(jnp.int32)]
+    if z_slabs is not None:
+        zlo, zhi = z_slabs
+        assert zlo.shape == zhi.shape == (Xr, Yr, s_off), (zlo.shape, raw.shape)
+        slab_spec = pl.BlockSpec((1, Yr, s_off), lambda i: (i, 0, 0))
+        in_specs += [slab_spec, slab_spec]
+        out_specs = (
+            out_specs,
+            pl.BlockSpec((1, Yr, s_off), out_idx),
+            pl.BlockSpec((1, Yr, s_off), out_idx),
+        )
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((Xr, Yr, s_off), raw.dtype),
+            jax.ShapeDtypeStruct((Xr, Yr, s_off), raw.dtype),
+        )
+        args += [zlo, zhi]
     return pl.pallas_call(
         kernel,
         grid=(Xr,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, Yr, Zr), lambda i: (i, 0, 0)),
-            # constant index map: fetched once, stays resident in VMEM
-            pl.BlockSpec((Yr, Zr), lambda i: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, Yr, Zr), lambda i: (jnp.maximum(i - m, 0), 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((Xr, Yr, Zr), raw.dtype),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         # in-place: the write of plane i-m trails the fetch of plane i+1 by
         # m+1 planes, so aliasing is hazard-free; unwritten high-shell planes
         # keep their pre-step bytes
         input_output_aliases={1: 0} if alias else {},
         scratch_shapes=[pltpu.VMEM((m, 2, Yr, Zr), raw.dtype)],
         interpret=interpret,
-    )(origin.astype(jnp.int32), raw, d2.astype(jnp.int32))
+    )(*args)
 
 
 def jacobi_slab_step(

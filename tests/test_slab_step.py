@@ -115,8 +115,12 @@ def test_slab_model_raw_readback_refreshes_shell():
 
 
 def test_slab_iteration_hlo_has_six_permutes():
-    """One slab iteration = exactly 6 collective-permutes (2 per axis)."""
-    m = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=True)
+    """One forced-slab iteration = exactly 6 collective-permutes (2 per
+    axis).  The default wavefront route trades message count for in-VMEM z
+    handling: 6 face messages plus 8 small corner-forwarding permutes (its
+    z slabs are extended with y- then x-neighbor pieces), all slab-sized."""
+    m = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=True,
+                 pallas_path="slab")
     m.realize()
     text = m._step.lower(m.dd._curr, 1).compile().as_text()
     assert text.count("collective-permute-start") <= 6, text.count(
@@ -126,3 +130,17 @@ def test_slab_iteration_hlo_has_six_permutes():
         "collective-permute-start("
     )
     assert n_permutes == 6, n_permutes
+
+
+def test_wavefront_macro_hlo_permute_count(monkeypatch):
+    """The z-slab wavefront macro: 4 array sweeps (x/y) + 2 z-slab permutes
+    + 8 corner-forwarding extension permutes = 14, independent of depth."""
+    monkeypatch.delenv("STENCIL_Z_SLABS", raising=False)  # pin z-slab mode on
+    m = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=True)
+    m.realize()
+    assert m._pallas_path == "wavefront" and m._wavefront_z_slabs
+    text = m._step.lower(m.dd._curr, m._wavefront_m).compile().as_text()
+    n_permutes = text.count("collective-permute(") + text.count(
+        "collective-permute-start("
+    )
+    assert n_permutes == 14, n_permutes

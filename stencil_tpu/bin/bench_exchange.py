@@ -121,13 +121,29 @@ def main(argv=None) -> int:
     p.add_argument(
         "--inner",
         type=int,
-        default=1,
+        default=None,
         help="exchanges per device dispatch (use >1 on tunneled backends; "
-        "per-iter time = (dispatch - host_rt) / inner)",
+        "per-iter time = (dispatch - host_rt) / inner; default: 1, or "
+        "auto-raised when the host round trip would swamp the exchange)",
     )
     args = p.parse_args(argv)
 
-    rt = _common.host_round_trip_s() if args.inner > 1 else 0.0
+    rt = _common.host_round_trip_s()
+    if args.inner is None:
+        args.inner = 1
+        if rt > 10e-3:
+            # unset --inner + a tunnel-scale round trip (~100 ms; a real
+            # host is ~us): a per-iteration sync would swamp the exchange,
+            # so switch to the exchanges-per-dispatch protocol
+            args.inner = 16
+            if jax.process_index() == 0:
+                print(
+                    f"host round trip {rt*1e3:.0f} ms: auto --inner 16 "
+                    "(per-iter time = (dispatch - rt) / inner)",
+                    file=sys.stderr,
+                )
+    if args.inner == 1:
+        rt = 0.0
     ext = (args.x, args.y, args.z)
     if jax.process_index() == 0:
         print(report_header())

@@ -60,10 +60,22 @@ from stencil_tpu.utils.logging import log_debug, log_info
 
 @dataclasses.dataclass(frozen=True)
 class DataHandle:
-    """Typed handle to a named quantity (reference local_domain.cuh:17-25)."""
+    """Typed handle to a named quantity (reference local_domain.cuh:17-25).
+
+    ``components`` are leading per-cell dims (N-D data — the reference's
+    future-work item, README.md:157-176): a (3,)-component quantity stores a
+    vector per cell as a (3, X, Y, Z) array, unsharded on the component dim.
+    """
 
     name: str
     dtype: object
+    components: tuple = ()
+
+    def cell_count(self) -> int:
+        n = 1
+        for c in self.components:
+            n *= c
+        return n
 
 
 @dataclasses.dataclass
@@ -102,7 +114,8 @@ class ShardView:
         for ax, d in zip(range(3), (dx, dy, dz)):
             s = self._region[ax]
             idx.append(slice(self._lo[ax] + s.start + d, self._lo[ax] + s.stop + d))
-        return self._block[tuple(idx)]
+        # leading component dims (N-D data) ride unsliced
+        return self._block[(Ellipsis,) + tuple(idx)]
 
     def center(self) -> jax.Array:
         return self.sh(0, 0, 0)
@@ -133,6 +146,12 @@ class BlockInfo:
 
 #: a step kernel: (views, info) -> {name: new values for info.region}
 StepKernel = Callable[[Dict[str, ShardView], BlockInfo], Dict[str, jax.Array]]
+
+
+def _qspec(h: DataHandle) -> P:
+    """PartitionSpec for a quantity: spatial dims sharded over the mesh,
+    leading component dims (N-D data) unsharded."""
+    return P(*([None] * len(h.components)), *MESH_AXES)
 
 
 class DistributedDomain:
@@ -171,8 +190,8 @@ class DistributedDomain:
     def radius(self) -> Radius:
         return self._radius
 
-    def add_data(self, name: str, dtype=jnp.float32) -> DataHandle:
-        h = DataHandle(name, jnp.dtype(dtype))
+    def add_data(self, name: str, dtype=jnp.float32, components=()) -> DataHandle:
+        h = DataHandle(name, jnp.dtype(dtype), tuple(components))
         self._handles.append(h)
         return h
 
@@ -270,8 +289,9 @@ class DistributedDomain:
             return
         t0 = time.perf_counter()
         for h in self._handles:
-            self._curr[h.name] = jnp.zeros(gshape, dtype=h.dtype, device=sharding)
-            self._next[h.name] = jnp.zeros(gshape, dtype=h.dtype, device=sharding)
+            hsharding = NamedSharding(self.mesh, _qspec(h))
+            self._curr[h.name] = jnp.zeros(h.components + gshape, dtype=h.dtype, device=hsharding)
+            self._next[h.name] = jnp.zeros(h.components + gshape, dtype=h.dtype, device=hsharding)
         self.stats.time_realize = time.perf_counter() - t0
         t0 = time.perf_counter()
         if self._methods in (MethodFlags.AllGather, MethodFlags.RollCompare):
@@ -284,6 +304,10 @@ class DistributedDomain:
 
             if any(v is not None for v in self._valid_last):
                 raise ValueError("debug exchange methods require even sizes")
+            if any(h.components for h in self._handles):
+                raise ValueError(
+                    "debug exchange methods support scalar quantities only"
+                )
             maker = (
                 make_exchange_fn_allgather
                 if self._methods == MethodFlags.AllGather
@@ -309,9 +333,12 @@ class DistributedDomain:
         dim = self.placement.dim()
         raw = self._spec.raw_size()
         gshape = (dim.x * raw.x, dim.y * raw.y, dim.z * raw.z)
-        sharding = NamedSharding(self.mesh, P(*MESH_AXES))
         return {
-            h.name: jax.ShapeDtypeStruct(gshape, h.dtype, sharding=sharding)
+            h.name: jax.ShapeDtypeStruct(
+                h.components + gshape,
+                h.dtype,
+                sharding=NamedSharding(self.mesh, _qspec(h)),
+            )
             for h in self._handles
         }
 
@@ -347,23 +374,27 @@ class DistributedDomain:
 
     # --- data movement --------------------------------------------------------
     def _to_raw_global(self, interior: np.ndarray, dtype) -> np.ndarray:
-        """Scatter a (X,Y,Z) user-domain array into the shell-carrying global
-        layout (host-side; used for init and small domains)."""
+        """Scatter a (*components, X,Y,Z) user-domain array into the
+        shell-carrying global layout (host-side; used for init and small
+        domains).  Leading component dims pass through."""
         dim = self.placement.dim()
         n = self._spec.sz
         raw = self._spec.raw_size()
         lo = self._shell_radius.lo()
-        out = np.zeros((dim.x * raw.x, dim.y * raw.y, dim.z * raw.z), dtype=dtype)
+        comps = interior.shape[:-3]
+        out = np.zeros(comps + (dim.x * raw.x, dim.y * raw.y, dim.z * raw.z), dtype=dtype)
         for ix in range(dim.x):
             for iy in range(dim.y):
                 for iz in range(dim.z):
                     v = self.shard_valid((ix, iy, iz))
                     src = interior[
+                        ...,
                         ix * n.x : ix * n.x + v.x,
                         iy * n.y : iy * n.y + v.y,
                         iz * n.z : iz * n.z + v.z,
                     ]
                     out[
+                        ...,
                         ix * raw.x + lo.x : ix * raw.x + lo.x + v.x,
                         iy * raw.y + lo.y : iy * raw.y + lo.y + v.y,
                         iz * raw.z + lo.z : iz * raw.z + lo.z + v.z,
@@ -375,16 +406,19 @@ class DistributedDomain:
         n = self._spec.sz
         raw = self._spec.raw_size()
         lo = self._shell_radius.lo()
-        out = np.zeros((self._size.x, self._size.y, self._size.z), dtype=raw_arr.dtype)
+        comps = raw_arr.shape[:-3]
+        out = np.zeros(comps + (self._size.x, self._size.y, self._size.z), dtype=raw_arr.dtype)
         for ix in range(dim.x):
             for iy in range(dim.y):
                 for iz in range(dim.z):
                     v = self.shard_valid((ix, iy, iz))
                     out[
+                        ...,
                         ix * n.x : ix * n.x + v.x,
                         iy * n.y : iy * n.y + v.y,
                         iz * n.z : iz * n.z + v.z,
                     ] = raw_arr[
+                        ...,
                         ix * raw.x + lo.x : ix * raw.x + lo.x + v.x,
                         iy * raw.y + lo.y : iy * raw.y + lo.y + v.y,
                         iz * raw.z + lo.z : iz * raw.z + lo.z + v.z,
@@ -392,10 +426,12 @@ class DistributedDomain:
         return out
 
     def set_quantity(self, h: DataHandle, interior: np.ndarray, slot: str = "curr") -> None:
-        """Load a full (X,Y,Z) user-domain array into a quantity's interior."""
-        assert interior.shape == tuple(self._size), (interior.shape, self._size)
+        """Load a full (*components, X,Y,Z) user-domain array into a
+        quantity's interior."""
+        want = h.components + tuple(self._size)
+        assert interior.shape == want, (interior.shape, want)
         raw = self._to_raw_global(np.asarray(interior), h.dtype)
-        sharding = NamedSharding(self.mesh, P(*MESH_AXES))
+        sharding = NamedSharding(self.mesh, _qspec(h))
         arr = jax.device_put(jnp.asarray(raw), sharding)
         (self._curr if slot == "curr" else self._next)[h.name] = arr
 
@@ -419,7 +455,7 @@ class DistributedDomain:
         lo = self._shell_radius.lo()
         arr = (self._curr if slot == "curr" else self._next)[h.name]
         ext = r.extent()
-        out = np.zeros((ext.x, ext.y, ext.z), dtype=h.dtype)
+        out = np.zeros(h.components + (ext.x, ext.y, ext.z), dtype=h.dtype)
         shard_lo = Dim3(*(r.lo[a] // n[a] for a in range(3)))
         shard_hi = Dim3(*((r.hi[a] - 1) // n[a] if r.hi[a] > r.lo[a] else shard_lo[a] for a in range(3)))
         for ix in range(shard_lo.x, min(shard_hi.x, dim.x - 1) + 1):
@@ -433,11 +469,13 @@ class DistributedDomain:
                     if not (ohi - olo).all_gt(0):
                         continue
                     block = arr[
+                        ...,
                         ix * raw.x + lo.x + olo.x - ix * n.x : ix * raw.x + lo.x + ohi.x - ix * n.x,
                         iy * raw.y + lo.y + olo.y - iy * n.y : iy * raw.y + lo.y + ohi.y - iy * n.y,
                         iz * raw.z + lo.z + olo.z - iz * n.z : iz * raw.z + lo.z + ohi.z - iz * n.z,
                     ]
                     out[
+                        ...,
                         olo.x - r.lo.x : ohi.x - r.lo.x,
                         olo.y - r.lo.y : ohi.y - r.lo.y,
                         olo.z - r.lo.z : ohi.z - r.lo.z,
@@ -481,6 +519,8 @@ class DistributedDomain:
         lo = self._shell_radius.lo()
         mesh_shape = tuple(self.mesh.shape[a] for a in MESH_AXES)
 
+        comps = h.components
+
         def per_shard(block):
             ox = lax.axis_index(MESH_AXES[0]) * n.x
             oy = lax.axis_index(MESH_AXES[1]) * n.y
@@ -490,15 +530,17 @@ class DistributedDomain:
                 cy = oy - lo.y + jnp.arange(raw.y)
                 cz = oz - lo.z + jnp.arange(raw.z)
                 vals = fn(cx[:, None, None], cy[None, :, None], cz[None, None, :])
-                return jnp.broadcast_to(vals, tuple(raw)).astype(block.dtype)
+                return jnp.broadcast_to(vals, comps + tuple(raw)).astype(block.dtype)
             cx = ox + jnp.arange(n.x)
             cy = oy + jnp.arange(n.y)
             cz = oz + jnp.arange(n.z)
             vals = fn(cx[:, None, None], cy[None, :, None], cz[None, None, :])
-            vals = jnp.broadcast_to(vals, tuple(n)).astype(block.dtype)
-            return block.at[lo.x : lo.x + n.x, lo.y : lo.y + n.y, lo.z : lo.z + n.z].set(vals)
+            vals = jnp.broadcast_to(vals, comps + tuple(n)).astype(block.dtype)
+            return block.at[
+                ..., lo.x : lo.x + n.x, lo.y : lo.y + n.y, lo.z : lo.z + n.z
+            ].set(vals)
 
-        spec = P(*MESH_AXES)
+        spec = _qspec(h)
         out = jax.jit(
             jax.shard_map(per_shard, mesh=self.mesh, in_specs=(spec,), out_specs=spec)
         )(self._curr[h.name])
@@ -578,7 +620,10 @@ class DistributedDomain:
         (src/stencil.cu:6-25 exchange_bytes_for_method analog)."""
         from stencil_tpu.core.geometry import exchange_bytes
 
-        per_dom = exchange_bytes(self._spec, [h.dtype.itemsize for h in self._handles])
+        per_dom = exchange_bytes(
+            self._spec,
+            [h.dtype.itemsize * h.cell_count() for h in self._handles],
+        )
         return per_dom * self.num_subdomains()
 
     def write_plan(self, prefix: str = "plan") -> str:
@@ -591,7 +636,7 @@ class DistributedDomain:
 
         lines = [self.placement.report(), "", "# messages (method=ppermute for all)"]
         spec = self._spec
-        itemsizes = [h.dtype.itemsize for h in self._handles]
+        itemsizes = [h.dtype.itemsize * h.cell_count() for h in self._handles]
         for d in DIRECTIONS_26:
             if spec.radius.dir(-d) == 0:
                 continue
@@ -684,7 +729,8 @@ class DistributedDomain:
             idx = tuple(
                 slice(lo[ax] + region[ax].start, lo[ax] + region[ax].stop) for ax in range(3)
             )
-            return new_block.at[idx].set(vals)
+            # leading component dims (N-D data) ride unsliced
+            return new_block.at[(Ellipsis,) + idx].set(vals)
 
         def one_step(blocks):
             """One macro step: exchange + ``mult`` compute sub-steps."""
@@ -742,7 +788,7 @@ class DistributedDomain:
             blocks = lax.fori_loop(0, steps, lambda _, b: one_step(b), blocks)
             return tuple(blocks[k] for k in names)
 
-        spec = P(*MESH_AXES)
+        specs = tuple(_qspec(h) for h in self._handles)
         donate_kw = {"donate_argnums": 0} if donate else {}
         # vma validation stays on whenever the exchange's blend kernels can't
         # engage — user kernels get full varying-manual-axes checking on the
@@ -750,7 +796,9 @@ class DistributedDomain:
         from stencil_tpu.ops import halo_blend
 
         check_vma = halo_blend.vma_check(
-            [h.dtype for h in self._handles], self._valid_last
+            [h.dtype for h in self._handles],
+            self._valid_last,
+            max((len(h.components) for h in self._handles), default=0),
         )
 
         @partial(jax.jit, static_argnums=1, **donate_kw)
@@ -758,8 +806,8 @@ class DistributedDomain:
             fn = jax.shard_map(
                 partial(per_shard, steps),
                 mesh=self.mesh,
-                in_specs=tuple(spec for _ in names),
-                out_specs=tuple(spec for _ in names),
+                in_specs=specs,
+                out_specs=specs,
                 check_vma=check_vma,
             )
             outs = fn(*[curr[k] for k in names])

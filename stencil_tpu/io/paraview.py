@@ -17,7 +17,16 @@ def write_paraview(dd, prefix: str, zero_nans: bool = True) -> None:
     """One file per subdomain, matching the reference's id and row layout."""
     dim = dd.placement.dim()
     n = dd.local_spec().sz
-    names = [h.name or f"data{i}" for i, h in enumerate(dd._handles)]
+    # N-D quantities dump one column per component: "v" (3,) -> v_0,v_1,v_2
+    names = []
+    for i, h in enumerate(dd._handles):
+        base = h.name or f"data{i}"
+        if h.components:
+            names += [
+                base + "_" + "_".join(map(str, c)) for c in np.ndindex(*h.components)
+            ]
+        else:
+            names.append(base)
     fields = {h.name: dd.quantity_to_host(h) for h in dd._handles}
 
     for i in range(dim.flatten()):
@@ -38,16 +47,19 @@ def write_paraview(dd, prefix: str, zero_nans: bool = True) -> None:
         )
         cols = [zz.ravel(), yy.ravel(), xx.ravel()]
         for h in dd._handles:
-            block = fields[h.name][
-                origin.x : origin.x + v.x,
-                origin.y : origin.y + v.y,
-                origin.z : origin.z + v.z,
-            ]
-            vals = np.transpose(block, (2, 1, 0)).ravel().astype(np.float64)
-            if zero_nans:
-                # zero NaN only; keep +-inf verbatim so divergence stays visible
-                vals = np.nan_to_num(vals, nan=0.0, posinf=np.inf, neginf=-np.inf)
-            cols.append(vals)
+            field = fields[h.name]
+            comps = list(np.ndindex(*h.components)) if h.components else [()]
+            for c in comps:
+                block = field[c][
+                    origin.x : origin.x + v.x,
+                    origin.y : origin.y + v.y,
+                    origin.z : origin.z + v.z,
+                ]
+                vals = np.transpose(block, (2, 1, 0)).ravel().astype(np.float64)
+                if zero_nans:
+                    # zero NaN only; keep +-inf verbatim (divergence visible)
+                    vals = np.nan_to_num(vals, nan=0.0, posinf=np.inf, neginf=-np.inf)
+                cols.append(vals)
         table = np.column_stack(cols)
         header = "Z,Y,X" + "".join(f",{c}" for c in names)
         fmt = ["%d", "%d", "%d"] + ["%f"] * len(names)

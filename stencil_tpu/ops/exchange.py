@@ -358,13 +358,18 @@ def make_exchange_fn(
     """Build a jitted exchange over a pytree of shell-carrying global arrays.
 
     Returns ``exchange(arrays) -> arrays`` where each array is sharded
-    ``P('x','y','z')`` on its last three dims (``ndim_extra`` leading batch/
-    quantity dims are unsharded).  Donates its input: the halo write is
-    in-place in HBM, like the reference filling halos inside the existing
+    ``P('x','y','z')`` on its last three dims; leading component/batch dims
+    (N-D data, per leaf — ``leaf.ndim - 3``; ``ndim_extra`` sets a floor for
+    validation bookkeeping) are unsharded and ride inside the fused
+    per-direction messages.  Donates its input: the halo write is in-place
+    in HBM, like the reference filling halos inside the existing
     allocation.  ``valid_last`` — see ``halo_exchange_shard``.
     """
     mesh_shape = tuple(mesh.shape[a] for a in MESH_AXES)
-    spec = P(*([None] * ndim_extra), *MESH_AXES)
+
+    def leaf_spec(leaf) -> P:
+        assert leaf.ndim >= 3, leaf.shape
+        return P(*([None] * (leaf.ndim - 3)), *MESH_AXES)
 
     @partial(jax.jit, donate_argnums=0)
     def exchange(arrays):
@@ -379,13 +384,16 @@ def make_exchange_fn(
         # vma validation stays on whenever the blend kernels can't engage
         from stencil_tpu.ops import halo_blend
 
+        max_extra = max(
+            [ndim_extra] + [l.ndim - 3 for l in leaves], default=ndim_extra
+        )
         shard_fn = jax.shard_map(
             per_shard,
             mesh=mesh,
-            in_specs=tuple(spec for _ in leaves),
-            out_specs=tuple(spec for _ in leaves),
+            in_specs=tuple(leaf_spec(l) for l in leaves),
+            out_specs=tuple(leaf_spec(l) for l in leaves),
             check_vma=halo_blend.vma_check(
-                [l.dtype for l in leaves], valid_last, ndim_extra
+                [l.dtype for l in leaves], valid_last, max_extra
             ),
         )
         return jax.tree.unflatten(treedef, list(shard_fn(*leaves)))

@@ -51,6 +51,7 @@ def main():
         t = min(samples) / k  # per single jacobi iteration
         got = np.asarray(loop(fresh(), STEPS, k))
         if ref is None:
+            assert k == 1, "bit-exact baseline must be the k=1 run"
             ref = got
         line = (
             f"k={k}  {t*1e3:.3f} ms/iter  {N**3/t/1e9:.1f} Gcells/s"

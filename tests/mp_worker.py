@@ -77,8 +77,41 @@ def main() -> None:
         checked += 1
     assert checked == 4, checked
 
+    # --- production wavefront path across the process boundary --------------
+    # the multi-device pallas default (m-shell exchange + m-level wavefront,
+    # z-slab variant with corner forwarding) vs the jnp formulation, with the
+    # mesh split across BOTH processes — collectives cross the DCN analog
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    a = Jacobi3D(16, 16, 16)
+    a.realize()
+    b = Jacobi3D(16, 16, 16, kernel_impl="pallas", interpret=True)
+    b.realize()
+    assert b._pallas_path == "wavefront", b._pallas_path
+    assert b._wavefront_m == 2, b._wavefront_m
+    a.step(5)
+    b.step(5)  # 2 macros + a depth-1 remainder dispatch
+    na = a.dd.local_spec().sz
+    la, lb = a.dd._shell_radius.lo(), b.dd._shell_radius.lo()
+    ra, rb = a.dd.local_spec().raw_size(), b.dd.local_spec().raw_size()
+    aa, bb = a.dd.get_curr(a.h), b.dd.get_curr(b.h)
+    pairs = 0
+    for sa, sb in zip(aa.addressable_shards, bb.addressable_shards):
+        ca = [sa.index[d].start // ra[d] for d in range(3)]
+        cb = [sb.index[d].start // rb[d] for d in range(3)]
+        assert ca == cb, (ca, cb)
+        xa = np.asarray(sa.data)[
+            la.x : la.x + na.x, la.y : la.y + na.y, la.z : la.z + na.z
+        ]
+        xb = np.asarray(sb.data)[
+            lb.x : lb.x + na.x, lb.y : lb.y + na.y, lb.z : lb.z + na.z
+        ]
+        np.testing.assert_allclose(xa, xb, rtol=1e-6)
+        pairs += 1
+    assert pairs == 4, pairs
+
     distributed.barrier("mp_done")
-    print(f"MP_OK {pid} shards={checked}", flush=True)
+    print(f"MP_OK {pid} shards={checked} wavefront_shards={pairs}", flush=True)
 
 
 if __name__ == "__main__":

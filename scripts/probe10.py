@@ -51,7 +51,9 @@ def main():
         t = min(samples) / k  # per single jacobi iteration
         got = np.asarray(loop(fresh(), STEPS, k))
         if ref is None:
-            assert k == 1, "bit-exact baseline must be the k=1 run"
+            if k != 1:  # k=1 baseline failed; later rows have no ground truth
+                print(f"k={k}  (no k=1 baseline; bit-exact not checked)", flush=True)
+                continue
             ref = got
         line = (
             f"k={k}  {t*1e3:.3f} ms/iter  {N**3/t/1e9:.1f} Gcells/s"

@@ -203,6 +203,7 @@ class Jacobi3D:
         )
         from stencil_tpu.ops.jacobi_pallas import (
             jacobi_shell_wavefront_step,
+            pack_d2,
             yz_dist2_plane,
         )
         from stencil_tpu.parallel.mesh import MESH_AXES
@@ -229,7 +230,10 @@ class Jacobi3D:
             origin = jnp.stack(
                 [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
             )
-            yz_d2 = yz_dist2_plane(origin[1] - m, origin[2] - m, (raw.y, raw.z), gsize)
+            yz_d2 = pack_d2(
+                yz_dist2_plane(origin[1] - m, origin[2] - m, (raw.y, raw.z), gsize),
+                gsize,
+            )
 
             if not z_slab_mode:
                 def macro_plain(depth, b):
@@ -259,16 +263,16 @@ class Jacobi3D:
                 return S.at[0:m].set(lo).at[Xr - m : Xr].set(hi)
 
             def macro(depth, carry):
-                b, ztop, zbot = carry
+                b, zout = carry
                 # x/y shells in the array (cheap: planes / sublane rows)
                 b = halo_exchange_shard(b, shell, mesh_shape, axes=(0, 1))
-                zlo = _shift_from_low(ztop, MESH_AXES[2], mesh_shape[2])
-                zhi = _shift_from_high(zbot, MESH_AXES[2], mesh_shape[2])
-                zlo = xext(yext(zlo))
-                zhi = xext(yext(zhi))
+                # zout packs [(-z)-bound | (+z)-bound] messages
+                zlo = _shift_from_low(zout[:, :, 0:m], MESH_AXES[2], mesh_shape[2])
+                zhi = _shift_from_high(zout[:, :, m : 2 * m], MESH_AXES[2], mesh_shape[2])
+                zs = jnp.concatenate([xext(yext(zlo)), xext(yext(zhi))], axis=2)
                 return jacobi_shell_wavefront_step(
                     b, depth, origin, yz_d2, gsize, interior_offset=m,
-                    z_slabs=(zlo, zhi), interpret=interpret,
+                    z_slabs=zs, interpret=interpret,
                 )
 
             # prime the slab carry from the block's interior z boundaries
@@ -276,8 +280,13 @@ class Jacobi3D:
             # kernel-emitted)
             carry = (
                 raw_block,
-                raw_block[:, :, Zr - 2 * m : Zr - m],
-                raw_block[:, :, m : 2 * m],
+                jnp.concatenate(
+                    [
+                        raw_block[:, :, Zr - 2 * m : Zr - m],
+                        raw_block[:, :, m : 2 * m],
+                    ],
+                    axis=2,
+                ),
             )
             macros, rem = divmod(steps, m)
             carry = lax.fori_loop(0, macros, lambda _, c: macro(m, c), carry)

@@ -58,8 +58,11 @@ def yz_dist2_plane(origin_y, origin_z, shape_yz: Tuple[int, int], global_size) -
 #: stack margin its temporaries (rolls, selects) claim beyond the block
 #: buffers.  Calibrated against eight observed compile pass/fail points
 #: (probe10/10b/14/14b, v5e): e.g. wrap 512^2-plane k=3 passes (14.5 MB
-#: modeled), k=4 fails (16.6); wavefront 516^2-plane m=2 passes (15.0),
-#: +z-slabs fails at a REPORTED 17.08 MB vs 17.11 modeled.
+#: modeled), k=4 fails (16.6); wavefront 516^2-plane m=2 passes (15.0).
+#: (The z-slab anchor predates the packed-slab layout: the OLD 8-block
+#: model put 516^2 m=2 +slabs at 17.11 MB vs a compiler-REPORTED 17.08;
+#: today's 4-block model computes 16.05 for the same shape — still over
+#: the limit, and the gate still correctly rejects it.)
 _VMEM_LIMIT = 16_000_000
 _VMEM_STACK_MARGIN = 3_000_000
 
@@ -78,23 +81,43 @@ def _padded_plane_bytes(plane_y: int, plane_z: int, itemsize: int) -> int:
 
 
 def wavefront_vmem_bytes(
-    k: int, plane_y: int, plane_z: int, itemsize: int, z_slabs: bool = False
+    k: int,
+    plane_y: int,
+    plane_z: int,
+    itemsize: int,
+    z_slabs: bool = False,
+    d2_itemsize: int = 4,
 ) -> int:
     """Modeled VMEM footprint of a k-level plane wavefront: 2k ring planes,
-    4 pipeline (in/out double-buffer) planes, the resident int32 d2 plane,
-    and (z-slab variant) 8 double-buffered slab blocks."""
+    4 pipeline (in/out double-buffer) planes, the resident d2 plane
+    (``d2_itemsize`` 2 when ``pack_d2`` can clamp to int16), and (z-slab
+    variant) 4 double-buffered packed-slab blocks."""
     plane = _padded_plane_bytes(plane_y, plane_z, itemsize)
-    est = (2 * k + 4) * plane + _padded_plane_bytes(plane_y, plane_z, 4)
+    est = (2 * k + 4) * plane + _padded_plane_bytes(plane_y, plane_z, d2_itemsize)
     if z_slabs:
-        est += 8 * _padded_plane_bytes(plane_y, 1, itemsize)
+        est += 4 * _padded_plane_bytes(plane_y, 1, itemsize)
     return est
 
 
 def wavefront_vmem_fits(
-    k: int, plane_y: int, plane_z: int, itemsize: int, z_slabs: bool = False
+    k: int,
+    plane_y: int,
+    plane_z: int,
+    itemsize: int,
+    z_slabs: bool = False,
+    d2_itemsize: int = 4,
 ) -> bool:
-    est = wavefront_vmem_bytes(k, plane_y, plane_z, itemsize, z_slabs)
+    est = wavefront_vmem_bytes(k, plane_y, plane_z, itemsize, z_slabs, d2_itemsize)
     return est + _VMEM_STACK_MARGIN <= _VMEM_LIMIT
+
+
+def pack_d2(yz_d2: jax.Array, global_size) -> jax.Array:
+    """The d2 plane as int32.  (An int16 clamp would halve the resident
+    plane and is numerically exact for gx < ~1800, but Mosaic on v5e
+    rejects 16-bit vector comparisons — "Target does not support this
+    comparison" — so the narrow form is not usable today.)"""
+    del global_size
+    return yz_d2.astype(jnp.int32)
 
 
 def warn_if_over_vmem_budget(k: int, plane_y: int, plane_z: int, itemsize: int) -> None:
@@ -235,14 +258,17 @@ def jacobi_shell_wavefront_step(
     interpret: bool = False,
     alias: bool = True,  # in-place (input_output_aliases); False trades the
     # aliasing for a fresh output buffer (uninitialized high shell)
-    z_slabs: Tuple[jax.Array, jax.Array] = None,  # (zlo, zhi), each
-    # (Xr, Yr, s) with s = the shell width: the z-halo content, kept OUT of
-    # the big array (a z halo write/read on the tiled layout costs a whole
-    # (8,128)-tile column pass, ~64x amplification — scripts/probe12d); the
-    # kernel patches the z columns of every streamed plane in VMEM instead
-    # and, when set, ALSO emits the next macro step's outgoing z slabs
-    # (my interior z-boundary columns at the output level), returning
-    # (out, z_top, z_bot) with z_top = cols [Zr-2s, Zr-s), z_bot = [s, 2s).
+    z_slabs: jax.Array = None,  # (Xr, Yr, 2s), s = the shell width: the
+    # z-halo content, kept OUT of the big array (a z halo write/read on the
+    # tiled layout costs a whole (8,128)-tile column pass, ~64x
+    # amplification — scripts/probe12d).  Cols [0, s) = my low halo (zlo),
+    # [s, 2s) = my high halo (zhi) — ONE packed buffer so the pipeline
+    # streams half the slab blocks.  The kernel patches the z columns of
+    # every streamed plane in VMEM instead and, when set, ALSO emits the
+    # next macro step's outgoing slabs in the same packed layout, returning
+    # (out, z_out) with z_out cols [0, s) = my top interior cols
+    # [Zr-2s, Zr-s) (the -z-bound message) and [s, 2s) = my bottom interior
+    # cols [s, 2s) (the +z-bound message).
 ) -> jax.Array:
     """``m`` Jacobi levels over an m-shell-carrying shard in ONE pass — the
     multi-device temporal-blocking path.
@@ -282,7 +308,7 @@ def jacobi_shell_wavefront_step(
 
     def kernel(origin_ref, in_ref, d2_ref, *rest):
         if z_slabs is not None:
-            zlo_ref, zhi_ref, out_ref, ztop_ref, zbot_ref, ring = rest
+            zs_ref, out_ref, zout_ref, ring = rest
         else:
             out_ref, ring = rest
         # ring[s] holds the two most recent level-s planes (level 0 = input)
@@ -294,9 +320,9 @@ def jacobi_shell_wavefront_step(
             # the big array
             col = jax.lax.broadcasted_iota(jnp.int32, (Yr, Zr), 1)
             for j in range(s_off):
-                vals = jnp.where(col == j, zlo_ref[0, :, j][:, None], vals)
+                vals = jnp.where(col == j, zs_ref[0, :, j][:, None], vals)
                 vals = jnp.where(
-                    col == Zr - s_off + j, zhi_ref[0, :, j][:, None], vals
+                    col == Zr - s_off + j, zs_ref[0, :, s_off + j][:, None], vals
                 )
         for s in range(1, m + 1):
             prev = ring[s - 1, i % 2]  # level-(s-1) plane i-s-1
@@ -318,6 +344,7 @@ def jacobi_shell_wavefront_step(
             x_g = jax.lax.rem(
                 origin_ref[0] + jnp.int32(gx) + i - jnp.int32(s + s_off), jnp.int32(gx)
             )
+
             val = jnp.where(d2v < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
             val = jnp.where(d2v < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
             vals = val.astype(vals.dtype)
@@ -325,11 +352,13 @@ def jacobi_shell_wavefront_step(
         if z_slabs is not None:
             # emit next macro's outgoing z slabs: my interior z-boundary
             # columns at the output level (shell planes/rows carry garbage
-            # here; the caller's slab extensions overwrite them)
-            ztop_ref[0] = vals[:, Zr - 2 * s_off : Zr - s_off]
-            zbot_ref[0] = vals[:, s_off : 2 * s_off]
+            # here; the caller's slab extensions overwrite them), packed
+            # [(-z)-bound message | (+z)-bound message]
+            zout_ref[0, :, 0:s_off] = vals[:, Zr - 2 * s_off : Zr - s_off]
+            zout_ref[0, :, s_off : 2 * s_off] = vals[:, s_off : 2 * s_off]
 
     out_idx = lambda i: (jnp.maximum(i - m, 0), 0, 0)
+    assert jnp.issubdtype(d2.dtype, jnp.integer), d2.dtype
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
         pl.BlockSpec((1, Yr, Zr), lambda i: (i, 0, 0)),
@@ -338,23 +367,19 @@ def jacobi_shell_wavefront_step(
     ]
     out_specs = pl.BlockSpec((1, Yr, Zr), out_idx)
     out_shape = jax.ShapeDtypeStruct((Xr, Yr, Zr), raw.dtype)
-    args = [origin.astype(jnp.int32), raw, d2.astype(jnp.int32)]
+    args = [origin.astype(jnp.int32), raw, d2]
     if z_slabs is not None:
-        zlo, zhi = z_slabs
-        assert zlo.shape == zhi.shape == (Xr, Yr, s_off), (zlo.shape, raw.shape)
-        slab_spec = pl.BlockSpec((1, Yr, s_off), lambda i: (i, 0, 0))
-        in_specs += [slab_spec, slab_spec]
+        assert z_slabs.shape == (Xr, Yr, 2 * s_off), (z_slabs.shape, raw.shape)
+        in_specs += [pl.BlockSpec((1, Yr, 2 * s_off), lambda i: (i, 0, 0))]
         out_specs = (
             out_specs,
-            pl.BlockSpec((1, Yr, s_off), out_idx),
-            pl.BlockSpec((1, Yr, s_off), out_idx),
+            pl.BlockSpec((1, Yr, 2 * s_off), out_idx),
         )
         out_shape = (
             out_shape,
-            jax.ShapeDtypeStruct((Xr, Yr, s_off), raw.dtype),
-            jax.ShapeDtypeStruct((Xr, Yr, s_off), raw.dtype),
+            jax.ShapeDtypeStruct((Xr, Yr, 2 * s_off), raw.dtype),
         )
-        args += [zlo, zhi]
+        args += [z_slabs]
     return pl.pallas_call(
         kernel,
         grid=(Xr,),

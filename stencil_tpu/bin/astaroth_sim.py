@@ -44,6 +44,14 @@ def main(argv=None) -> int:
         default="pallas",
         help="pallas plane-streaming kernel (fast) or XLA slices",
     )
+    p.add_argument(
+        "--schedule",
+        choices=["per-step", "wavefront"],
+        default="per-step",
+        help="per-step: reference parity (exchange every iteration); "
+        "wavefront: exchange every m<=3 steps, m-level temporal kernel "
+        "(same field values, ~1/m the traffic)",
+    )
     args = p.parse_args(argv)
 
     num_subdoms = len(jax.devices())
@@ -64,6 +72,7 @@ def main(argv=None) -> int:
         strategy=_common.parse_strategy(args),
         kernel_impl=kernel_impl,
         interpret=jax.default_backend() == "cpu",
+        schedule=args.schedule,
     )
     sim.realize()
     sim.step()  # compile

@@ -44,6 +44,12 @@ class AstarothSim:
         dtype=jnp.float32,
         kernel_impl: str = "jnp",  # "jnp" | "pallas" (plane streaming)
         interpret: bool = False,
+        schedule: str = "per-step",  # "per-step" (reference parity: exchange
+        # every iteration, modeling Astaroth's comm volume) | "wavefront"
+        # (opt-in: the radius-3 shell already feeds 3 levels of the
+        # distance-1 stencil, so exchange every m <= 3 steps and run an
+        # m-level wavefront kernel — same field values up to last-ulp
+        # fusion effects, ~1/m the traffic)
     ):
         self.dd = DistributedDomain(x, y, z)
         self.dd.set_radius(Radius.constant(3))  # astaroth_sim.cu:184
@@ -57,7 +63,12 @@ class AstarothSim:
         self.overlap = overlap
         self.kernel_impl = kernel_impl
         self.interpret = interpret
+        if schedule not in ("per-step", "wavefront"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        self.schedule = schedule
         self._step = None
+        self._marks_shell_stale = False
+        self._wavefront_m = 0
 
     def realize(self) -> None:
         self.dd.realize()
@@ -72,41 +83,28 @@ class AstarothSim:
                     "overlap=False has no meaning for the fused pallas step; "
                     "use kernel_impl='jnp' for overlap comparisons"
                 )
-            self._step = self._make_pallas_step()
+            if self.schedule == "wavefront":
+                self._step = self._make_wavefront_step()
+            else:
+                self._step = self._make_pallas_step()
         else:
+            if self.schedule == "wavefront":
+                raise ValueError("schedule='wavefront' requires kernel_impl='pallas'")
             self._step = self.dd.make_step(self._kernel, overlap=self.overlap)
 
-    def _make_pallas_step(self):
-        """Plane-streaming mean-of-6 kernel (ops/plane_stencil) fused with the
-        exchange — one HBM read + one write per plane per iteration."""
+    def _wrap_step_fn(self, per_shard):
+        """Shared jit/shard_map wrapper for the pallas step makers:
+        ``per_shard(steps, *blocks) -> blocks`` over P('x','y','z') shards.
+        check_vma off: pallas_call outputs carry no vma annotation."""
         from functools import partial
 
         import jax
-        from jax import lax
         from jax.sharding import PartitionSpec as P
 
-        from stencil_tpu.ops.exchange import halo_exchange_multi
-        from stencil_tpu.ops.plane_stencil import mean6_plane_step
         from stencil_tpu.parallel.mesh import MESH_AXES
 
         dd = self.dd
-        shell = dd._shell_radius
-        lo, hi = shell.lo(), shell.hi()
-        mesh_shape = tuple(dd.mesh.shape[a] for a in MESH_AXES)
-        valid_last = dd._valid_last
-        interpret = self.interpret
         names = [h.name for h in self.handles]
-
-        def per_shard(steps, *blocks):
-            def body(_, bs):
-                # joint exchange: ≤6 permutes for any field count
-                bs = halo_exchange_multi(bs, shell, mesh_shape, valid_last=valid_last)
-                return tuple(
-                    mean6_plane_step(b, lo, hi, interpret=interpret) for b in bs
-                )
-
-            return lax.fori_loop(0, steps, body, tuple(blocks))
-
         spec = P(*MESH_AXES)
 
         @partial(jax.jit, static_argnums=1, donate_argnums=0)
@@ -122,6 +120,80 @@ class AstarothSim:
             return dict(zip(names, outs))
 
         return step
+
+    def _make_pallas_step(self):
+        """Plane-streaming mean-of-6 kernel (ops/plane_stencil) fused with the
+        exchange — one HBM read + one write per plane per iteration."""
+        from jax import lax
+
+        from stencil_tpu.ops.exchange import halo_exchange_multi
+        from stencil_tpu.ops.plane_stencil import mean6_plane_step
+        from stencil_tpu.parallel.mesh import MESH_AXES
+
+        dd = self.dd
+        shell = dd._shell_radius
+        lo, hi = shell.lo(), shell.hi()
+        mesh_shape = tuple(dd.mesh.shape[a] for a in MESH_AXES)
+        valid_last = dd._valid_last
+        interpret = self.interpret
+
+        def per_shard(steps, *blocks):
+            def body(_, bs):
+                # joint exchange: ≤6 permutes for any field count
+                bs = halo_exchange_multi(bs, shell, mesh_shape, valid_last=valid_last)
+                return tuple(
+                    mean6_plane_step(b, lo, hi, interpret=interpret) for b in bs
+                )
+
+            return lax.fori_loop(0, steps, body, tuple(blocks))
+
+        return self._wrap_step_fn(per_shard)
+
+    def _make_wavefront_step(self):
+        """Opt-in temporal schedule: one radius-3 shell exchange feeds an
+        m-level mean6 wavefront (m <= 3, VMEM-fitted) — the per-step
+        schedule's field values up to last-ulp fusion effects, at ~1/m the
+        exchange traffic and HBM passes.  Requires even (unpadded) sizes (the wavefront kernel has no
+        padded-axis form)."""
+        from jax import lax
+
+        from stencil_tpu.ops.exchange import halo_exchange_multi
+        from stencil_tpu.ops.jacobi_pallas import wavefront_vmem_fits
+        from stencil_tpu.ops.plane_stencil import mean6_shell_wavefront_step
+        from stencil_tpu.parallel.mesh import MESH_AXES
+
+        dd = self.dd
+        if any(v is not None for v in dd._valid_last):
+            raise ValueError("schedule='wavefront' requires even (unpadded) sizes")
+        shell = dd._shell_radius
+        s_w = shell.lo().x  # uniform radius 3
+        raw = dd.local_spec().raw_size()
+        itemsize = self.handles[0].dtype.itemsize
+        m = 1
+        for cand in range(2, s_w + 1):
+            if wavefront_vmem_fits(cand, raw.y, raw.z, itemsize, d2_itemsize=0):
+                m = cand
+        self._wavefront_m = m
+        mesh_shape = tuple(dd.mesh.shape[a] for a in MESH_AXES)
+        valid_last = dd._valid_last
+        interpret = self.interpret
+        self._marks_shell_stale = True
+
+        def per_shard(steps, *blocks):
+            def macro(depth, bs):
+                bs = halo_exchange_multi(bs, shell, mesh_shape, valid_last=valid_last)
+                return tuple(
+                    mean6_shell_wavefront_step(b, depth, s_w, interpret=interpret)
+                    for b in bs
+                )
+
+            macros, rem = divmod(steps, m)
+            bs = lax.fori_loop(0, macros, lambda _, b: macro(m, b), tuple(blocks))
+            if rem:
+                bs = macro(rem, bs)
+            return bs
+
+        return self._wrap_step_fn(per_shard)
 
     def _kernel(self, views, info):
         out = {}
@@ -139,6 +211,8 @@ class AstarothSim:
 
     def step(self, steps: int = 1) -> None:
         self.dd.run_step(self._step, steps)
+        if self._marks_shell_stale:
+            self.dd.mark_shell_stale()
 
     def field(self, i: int = 0) -> np.ndarray:
         return self.dd.quantity_to_host(self.handles[i])

@@ -93,7 +93,9 @@ def wavefront_vmem_bytes(
     (``d2_itemsize`` 2 when ``pack_d2`` can clamp to int16), and (z-slab
     variant) 4 double-buffered packed-slab blocks."""
     plane = _padded_plane_bytes(plane_y, plane_z, itemsize)
-    est = (2 * k + 4) * plane + _padded_plane_bytes(plane_y, plane_z, d2_itemsize)
+    est = (2 * k + 4) * plane
+    if d2_itemsize:  # 0 = kernel variant with no resident d2 plane
+        est += _padded_plane_bytes(plane_y, plane_z, d2_itemsize)
     if z_slabs:
         est += 4 * _padded_plane_bytes(plane_y, 1, itemsize)
     return est

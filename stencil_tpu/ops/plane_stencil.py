@@ -17,6 +17,67 @@ import jax.numpy as jnp
 from stencil_tpu.core.dim3 import Dim3
 
 
+def mean6_shell_wavefront_step(
+    raw: jax.Array,  # (X+2s, Y+2s, Z+2s), uniform s-wide FILLED shell
+    m: int,  # levels to advance, <= the shell width s
+    shell_width: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """``m`` mean-of-6 levels in ONE pass over an s-shell-carrying shard —
+    the Astaroth proxy's temporal wavefront (opt-in ``schedule="wavefront"``).
+
+    The proxy exchanges a radius-3 shell but reads distance 1
+    (astaroth_sim.cu:65-83), so the shell ALREADY holds enough boundary data
+    for 3 levels of the stencil: validity shrinks one cell per level exactly
+    as in ``jacobi_shell_wavefront_step`` (see its docstring for the
+    contamination argument), and each HBM plane is read and written once per
+    ``m`` iterations instead of once per iteration.  Shell cells land
+    garbage/stale; the caller re-exchanges before the next pass and marks
+    the shell stale for readback.  Summation order matches
+    ``mean6_plane_step`` (x-1, x+1, y-1, y+1, z-1, z+1)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from stencil_tpu.ops.jacobi_pallas import _make_roll
+
+    Xr, Yr, Zr = raw.shape
+    assert 1 <= m <= shell_width and 2 * shell_width < min(Xr, Yr, Zr), (
+        m, shell_width, raw.shape,
+    )
+    roll = _make_roll(interpret)
+
+    def kernel(in_ref, out_ref, ring):
+        # ring[s] holds the two most recent level-s planes (level 0 = input)
+        i = pl.program_id(0)
+        vals = in_ref[0]  # level-0 raw plane i
+        for s in range(1, m + 1):
+            prev = ring[s - 1, i % 2]  # level-(s-1) plane i-s-1
+            cent = ring[s - 1, (i + 1) % 2]  # level-(s-1) plane i-s
+            ring[s - 1, i % 2] = vals  # push plane i-s+1 (after prev read)
+            val = (
+                prev
+                + vals
+                + roll(cent, 1, 0)
+                + roll(cent, -1, 0)
+                + roll(cent, 1, 1)
+                + roll(cent, -1, 1)
+            ) / 6.0
+            vals = val.astype(vals.dtype)
+        out_ref[0] = vals  # level-m plane i-m; valid for the interior
+
+    return pl.pallas_call(
+        kernel,
+        grid=(Xr,),
+        in_specs=[pl.BlockSpec((1, Yr, Zr), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, Yr, Zr), lambda i: (jnp.maximum(i - m, 0), 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Xr, Yr, Zr), raw.dtype),
+        # write of plane i-m trails the fetch of plane i+1: in-place safe
+        input_output_aliases={0: 0},
+        scratch_shapes=[pltpu.VMEM((m, 2, Yr, Zr), raw.dtype)],
+        interpret=interpret,
+    )(raw)
+
+
 def mean6_plane_step(
     block: jax.Array, lo: Dim3, hi: Dim3, interpret: bool = False
 ) -> jax.Array:

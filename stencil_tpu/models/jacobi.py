@@ -182,9 +182,10 @@ class Jacobi3D:
         The z halos never touch the big array (``STENCIL_Z_SLABS=0``
         disables): a z-halo read or write on the tiled layout rewrites whole
         (8,128)-tile columns (~a full-domain pass per exchange, probe12d),
-        so the z-shell lives in separate (Xr, Yr, m) slab arrays that the
-        kernel consumes (VMEM column patching) and emits (next macro's
-        outgoing slabs).  Corner data propagates on the slabs themselves:
+        so the z-shell lives in a separate z-major (Xr, 2m, Yr) packed slab
+        array (rows [0,m) = low halo, [m,2m) = high) that the kernel
+        consumes (VMEM column patching via one small per-plane transpose)
+        and emits (next macro's outgoing slabs).  Corner data propagates on the slabs themselves:
         after the z ppermute, each slab is extended with rows from the y
         neighbors and then planes from the x neighbors (two hops carry the
         xyz-corner cells from the diagonal blocks), mirroring the sweep
@@ -250,12 +251,13 @@ class Jacobi3D:
                 return b
 
             def yext(S):
-                # my slab's y-shell rows hold the y neighbors' top/bottom
-                # interior rows of the SAME slab (post z-permute, so the
-                # yz-diagonal's data is already aboard)
-                lo = _shift_from_low(S[:, Yr - 2 * m : Yr - m, :], MESH_AXES[1], mesh_shape[1])
-                hi = _shift_from_high(S[:, m : 2 * m, :], MESH_AXES[1], mesh_shape[1])
-                return S.at[:, 0:m, :].set(lo).at[:, Yr - m : Yr, :].set(hi)
+                # my slab's y-shell rows (last axis in the z-major layout)
+                # hold the y neighbors' top/bottom interior rows of the SAME
+                # slab (post z-permute, so the yz-diagonal's data is already
+                # aboard)
+                lo = _shift_from_low(S[:, :, Yr - 2 * m : Yr - m], MESH_AXES[1], mesh_shape[1])
+                hi = _shift_from_high(S[:, :, m : 2 * m], MESH_AXES[1], mesh_shape[1])
+                return S.at[:, :, 0:m].set(lo).at[:, :, Yr - m : Yr].set(hi)
 
             def xext(S):
                 lo = _shift_from_low(S[Xr - 2 * m : Xr - m], MESH_AXES[0], mesh_shape[0])
@@ -266,26 +268,26 @@ class Jacobi3D:
                 b, zout = carry
                 # x/y shells in the array (cheap: planes / sublane rows)
                 b = halo_exchange_shard(b, shell, mesh_shape, axes=(0, 1))
-                # zout packs [(-z)-bound | (+z)-bound] messages
-                zlo = _shift_from_low(zout[:, :, 0:m], MESH_AXES[2], mesh_shape[2])
-                zhi = _shift_from_high(zout[:, :, m : 2 * m], MESH_AXES[2], mesh_shape[2])
-                zs = jnp.concatenate([xext(yext(zlo)), xext(yext(zhi))], axis=2)
+                # zout is z-major (Xr, 2m, Yr): [(-z)-bound | (+z)-bound]
+                zlo = _shift_from_low(zout[:, 0:m, :], MESH_AXES[2], mesh_shape[2])
+                zhi = _shift_from_high(zout[:, m : 2 * m, :], MESH_AXES[2], mesh_shape[2])
+                zs = jnp.concatenate([xext(yext(zlo)), xext(yext(zhi))], axis=1)
                 return jacobi_shell_wavefront_step(
                     b, depth, origin, yz_d2, gsize, interior_offset=m,
                     z_slabs=zs, interpret=interpret,
                 )
 
-            # prime the slab carry from the block's interior z boundaries
-            # (the one strided z read per dispatch; all later slabs are
-            # kernel-emitted)
+            # prime the slab carry from the block's interior z boundaries,
+            # transposed z-major (the one strided read per dispatch; all
+            # later slabs are kernel-emitted)
             carry = (
                 raw_block,
                 jnp.concatenate(
                     [
-                        raw_block[:, :, Zr - 2 * m : Zr - m],
-                        raw_block[:, :, m : 2 * m],
+                        jnp.swapaxes(raw_block[:, :, Zr - 2 * m : Zr - m], 1, 2),
+                        jnp.swapaxes(raw_block[:, :, m : 2 * m], 1, 2),
                     ],
-                    axis=2,
+                    axis=1,
                 ),
             )
             macros, rem = divmod(steps, m)

@@ -59,10 +59,11 @@ def yz_dist2_plane(origin_y, origin_z, shape_yz: Tuple[int, int], global_size) -
 #: buffers.  Calibrated against eight observed compile pass/fail points
 #: (probe10/10b/14/14b, v5e): e.g. wrap 512^2-plane k=3 passes (14.5 MB
 #: modeled), k=4 fails (16.6); wavefront 516^2-plane m=2 passes (15.0).
-#: (The z-slab anchor predates the packed-slab layout: the OLD 8-block
-#: model put 516^2 m=2 +slabs at 17.11 MB vs a compiler-REPORTED 17.08;
-#: today's 4-block model computes 16.05 for the same shape — still over
-#: the limit, and the gate still correctly rejects it.)
+#: (z-slab anchors, 516^2 m=2 +slabs: the ORIGINAL y-major 8-block layout
+#: modeled 17.11 MB vs a compiler-REPORTED 17.08 — rejected; the packed
+#: y-major 4-block layout REPORTED 16.08 — rejected by 80 KB; the current
+#: z-major 4-block layout models ~12.1 MB and compiles+runs on hardware at
+#: 74.5 Gcells/s, probe17.)
 _VMEM_LIMIT = 16_000_000
 _VMEM_STACK_MARGIN = 3_000_000
 
@@ -97,7 +98,8 @@ def wavefront_vmem_bytes(
     if d2_itemsize:  # 0 = kernel variant with no resident d2 plane
         est += _padded_plane_bytes(plane_y, plane_z, d2_itemsize)
     if z_slabs:
-        est += 4 * _padded_plane_bytes(plane_y, 1, itemsize)
+        # z-major (1, 2k, plane_y) blocks: sublane-pad the 2k rows
+        est += 4 * _padded_plane_bytes(2 * k, plane_y, itemsize)
     return est
 
 
@@ -260,17 +262,19 @@ def jacobi_shell_wavefront_step(
     interpret: bool = False,
     alias: bool = True,  # in-place (input_output_aliases); False trades the
     # aliasing for a fresh output buffer (uninitialized high shell)
-    z_slabs: jax.Array = None,  # (Xr, Yr, 2s), s = the shell width: the
-    # z-halo content, kept OUT of the big array (a z halo write/read on the
-    # tiled layout costs a whole (8,128)-tile column pass, ~64x
-    # amplification — scripts/probe12d).  Cols [0, s) = my low halo (zlo),
-    # [s, 2s) = my high halo (zhi) — ONE packed buffer so the pipeline
-    # streams half the slab blocks.  The kernel patches the z columns of
-    # every streamed plane in VMEM instead and, when set, ALSO emits the
-    # next macro step's outgoing slabs in the same packed layout, returning
-    # (out, z_out) with z_out cols [0, s) = my top interior cols
-    # [Zr-2s, Zr-s) (the -z-bound message) and [s, 2s) = my bottom interior
-    # cols [s, 2s) (the +z-bound message).
+    z_slabs: jax.Array = None,  # (Xr, 2s, Yr) TRANSPOSED, s = the shell
+    # width: the z-halo content, kept OUT of the big array (a z halo
+    # write/read on the tiled layout costs a whole (8,128)-tile column
+    # pass, ~64x amplification — scripts/probe12d).  Rows [0, s) = my low
+    # halo (zlo), [s, 2s) = my high halo (zhi) — ONE packed buffer, stored
+    # z-major so each streamed (1, 2s, Yr) block pads to (8, lanes) instead
+    # of (sublanes, 128): ~20 KB/block vs 266, the difference that fits
+    # 516^2 planes under the 16 MB scoped-VMEM limit.  The kernel transposes
+    # the small block in VMEM, patches the z columns of every streamed
+    # plane, and, when set, ALSO emits the next macro step's outgoing slabs
+    # in the same layout, returning (out, z_out) with z_out rows [0, s) =
+    # my top interior cols [Zr-2s, Zr-s) (the -z-bound message) and
+    # [s, 2s) = my bottom interior cols [s, 2s) (the +z-bound message).
 ) -> jax.Array:
     """``m`` Jacobi levels over an m-shell-carrying shard in ONE pass — the
     multi-device temporal-blocking path.
@@ -319,12 +323,14 @@ def jacobi_shell_wavefront_step(
         vals = in_ref[0]  # level-0 raw plane i
         if z_slabs is not None:
             # patch the z-shell columns in VMEM — they are never stored in
-            # the big array
+            # the big array.  One small (2s, Yr) -> (Yr, 2s) transpose per
+            # plane turns the z-major block into the column vectors needed.
+            zst = jnp.swapaxes(zs_ref[0], 0, 1)  # (Yr, 2s)
             col = jax.lax.broadcasted_iota(jnp.int32, (Yr, Zr), 1)
             for j in range(s_off):
-                vals = jnp.where(col == j, zs_ref[0, :, j][:, None], vals)
+                vals = jnp.where(col == j, zst[:, j][:, None], vals)
                 vals = jnp.where(
-                    col == Zr - s_off + j, zs_ref[0, :, s_off + j][:, None], vals
+                    col == Zr - s_off + j, zst[:, s_off + j][:, None], vals
                 )
         for s in range(1, m + 1):
             prev = ring[s - 1, i % 2]  # level-(s-1) plane i-s-1
@@ -355,9 +361,12 @@ def jacobi_shell_wavefront_step(
             # emit next macro's outgoing z slabs: my interior z-boundary
             # columns at the output level (shell planes/rows carry garbage
             # here; the caller's slab extensions overwrite them), packed
-            # [(-z)-bound message | (+z)-bound message]
-            zout_ref[0, :, 0:s_off] = vals[:, Zr - 2 * s_off : Zr - s_off]
-            zout_ref[0, :, s_off : 2 * s_off] = vals[:, s_off : 2 * s_off]
+            # [(-z)-bound message | (+z)-bound message], z-major
+            emit = jnp.concatenate(
+                [vals[:, Zr - 2 * s_off : Zr - s_off], vals[:, s_off : 2 * s_off]],
+                axis=1,
+            )  # (Yr, 2s)
+            zout_ref[0] = jnp.swapaxes(emit, 0, 1)
 
     out_idx = lambda i: (jnp.maximum(i - m, 0), 0, 0)
     assert jnp.issubdtype(d2.dtype, jnp.integer), d2.dtype
@@ -371,15 +380,15 @@ def jacobi_shell_wavefront_step(
     out_shape = jax.ShapeDtypeStruct((Xr, Yr, Zr), raw.dtype)
     args = [origin.astype(jnp.int32), raw, d2]
     if z_slabs is not None:
-        assert z_slabs.shape == (Xr, Yr, 2 * s_off), (z_slabs.shape, raw.shape)
-        in_specs += [pl.BlockSpec((1, Yr, 2 * s_off), lambda i: (i, 0, 0))]
+        assert z_slabs.shape == (Xr, 2 * s_off, Yr), (z_slabs.shape, raw.shape)
+        in_specs += [pl.BlockSpec((1, 2 * s_off, Yr), lambda i: (i, 0, 0))]
         out_specs = (
             out_specs,
-            pl.BlockSpec((1, Yr, 2 * s_off), out_idx),
+            pl.BlockSpec((1, 2 * s_off, Yr), out_idx),
         )
         out_shape = (
             out_shape,
-            jax.ShapeDtypeStruct((Xr, Yr, 2 * s_off), raw.dtype),
+            jax.ShapeDtypeStruct((Xr, 2 * s_off, Yr), raw.dtype),
         )
         args += [z_slabs]
     return pl.pallas_call(

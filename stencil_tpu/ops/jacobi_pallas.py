@@ -157,12 +157,21 @@ def choose_temporal_k(shape: Tuple[int, int, int], itemsize: int, requested="aut
 
 def _make_roll(interpret: bool):
     """Interpret-aware plane rotate shared by the streaming kernels: jnp.roll
-    in interpret mode, pltpu.roll (amount normalized into range) compiled."""
+    in interpret mode, pltpu.roll (amount normalized into range) compiled.
+    Mosaic's rotate is 32-bit-only ("Rotate with non-32-bit data"): narrower
+    FLOAT dtypes upcast to f32 (value-exact for bf16/f16) and stay f32 on
+    return, so the caller's stencil sum accumulates in f32 and downcasts
+    once at its existing per-level astype — better accuracy than a narrow
+    sum and fewer converts than a per-roll round trip (Mosaic CSEs the
+    repeated upcast of the same plane).  8-byte dtypes are not silently
+    truncated; they fail loudly in Mosaic."""
     from jax.experimental.pallas import tpu as pltpu
 
     def roll(v, amt, axis):
         if interpret:
             return jnp.roll(v, amt, axis)
+        if v.dtype.itemsize < 4 and jnp.issubdtype(v.dtype, jnp.floating):
+            return pltpu.roll(v.astype(jnp.float32), amt % v.shape[axis], axis)
         return pltpu.roll(v, amt % v.shape[axis], axis)
 
     return roll
@@ -480,7 +489,7 @@ def jacobi_slab_step(
             def zcol(ref):
                 if interpret:
                     return jnp.roll(ref[...], -o, axis=1)[:, 0:1]
-                return pltpu.roll(ref[...], (X - o) % X, 1)[:, 0:1]
+                return roll(ref[...], X - o, 1)[:, 0:1]
 
             left = jnp.where(col == 0, zcol(zlo_ref), left)
             right = jnp.where(col == Z - 1, zcol(zhi_ref), right)

@@ -17,6 +17,7 @@ import sys
 import time
 
 import jax
+import jax.numpy as jnp
 
 from stencil_tpu.bin import _common
 from stencil_tpu.models.jacobi import Jacobi3D, weak_scaled_size
@@ -40,6 +41,12 @@ def main(argv=None) -> int:
         choices=["pallas", "jnp"],
         default="pallas",
         help="pallas plane-streaming kernel (fast) or XLA slices",
+    )
+    p.add_argument(
+        "--dtype",
+        choices=["float32", "bfloat16"],
+        default="float32",
+        help="quantity dtype (bfloat16: precision-reduced, ~1.6x on v5e)",
     )
     p.add_argument(
         "--pallas-path",
@@ -85,6 +92,7 @@ def main(argv=None) -> int:
         kernel_impl=kernel_impl,
         interpret=jax.default_backend() == "cpu",
         pallas_path=args.pallas_path,
+        dtype=jnp.dtype(args.dtype),
     )
     if args.halo_multiplier > 1:
         model.dd.set_halo_multiplier(args.halo_multiplier)

@@ -116,8 +116,11 @@ def main() -> None:
         ex_dt = timed_run(ex_model, 100)
         ex_mcells_per_s = round(cells / ex_dt / 1e6 / max(1, ndev), 1)  # per chip
         ex_path = f"wavefront_m{ex_model._wavefront_m}"
-    except Exception as e:  # a device count that pads 512 must not kill the
-        import sys          # already-measured headline number
+    # ONLY the expected planning failure (a device count that pads 512) may
+    # be skipped; an AssertionError or a kernel failure in the wavefront
+    # route is a real regression and must fail the artifact
+    except ValueError as e:
+        import sys
 
         print(f"exchange-path bench skipped: {e}", file=sys.stderr)
         ex_mcells_per_s = None

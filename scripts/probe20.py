@@ -1,0 +1,116 @@
+"""Probe: does raising Mosaic's scoped-VMEM budget (CompilerParams.
+vmem_limit_bytes) unlock temporal depths k>3 at 512^3?
+
+The r04 calibration treated 16 MB as a hard compiler limit; probe9d already
+passed vmem_limit_bytes for copy kernels, so the 16 MB figure may be only the
+DEFAULT scoped budget, with physical VMEM far larger.  If k=6 compiles and
+scales, both VERDICT items 2 (wrap >= 112.5k) and 3 (wavefront >= 90k) fall.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from stencil_tpu.ops.jacobi_pallas import (
+    _make_roll,
+    sphere_params,
+    yz_dist2_plane,
+    HOT_TEMP,
+    COLD_TEMP,
+)
+from stencil_tpu.bin._common import host_round_trip_s
+
+
+def wrap_step_vmem(block, k, vmem_mb):
+    X, Y, Z = block.shape
+    gx = X
+    hot_x, cold_x, in_r2 = sphere_params(gx)
+    roll = _make_roll(False)
+
+    def kernel(in_ref, d2_ref, out_ref, ring):
+        i = pl.program_id(0)
+        d2 = d2_ref[...]
+        vals = in_ref[0]
+        for s in range(1, k + 1):
+            prev = ring[s - 1, i % 2]
+            cent = ring[s - 1, (i + 1) % 2]
+            ring[s - 1, i % 2] = vals
+            val = (
+                prev
+                + vals
+                + roll(cent, 1, 0)
+                + roll(cent, -1, 0)
+                + roll(cent, 1, 1)
+                + roll(cent, -1, 1)
+            ) / 6.0
+            x_g = (i - s) % X
+            val = jnp.where(d2 < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
+            val = jnp.where(d2 < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
+            vals = val.astype(vals.dtype)
+        out_ref[0] = vals
+
+    d2 = yz_dist2_plane(0, 0, (Y, Z), block.shape)
+    kw = {}
+    if vmem_mb:
+        kw["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_mb * 1024 * 1024
+        )
+    return pl.pallas_call(
+        kernel,
+        grid=(X + 2 * k,),
+        in_specs=[
+            pl.BlockSpec((1, Y, Z), lambda i: (i % X, 0, 0)),
+            pl.BlockSpec((Y, Z), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Y, Z), lambda i: ((i - k) % X, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
+        scratch_shapes=[pltpu.VMEM((k, 2, Y, Z), block.dtype)],
+        **kw,
+    )(block, d2.astype(jnp.int32))
+
+
+def main():
+    rt = host_round_trip_s()
+    print(f"host rt {rt*1e3:.1f} ms", flush=True)
+    n = 512
+    for k, vmem_mb in [(3, 0), (4, 64), (5, 64), (6, 64), (6, 100), (8, 100)]:
+        steps = 120 // k * k  # whole macro steps
+
+        @functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)
+        def loop(b, k, s):
+            return lax.fori_loop(
+                0, s // k, lambda _, x: wrap_step_vmem(x, k, vmem_mb), b
+            )
+
+        b = jnp.full((n, n, n), 0.5, jnp.float32)
+        try:
+            t_c0 = time.perf_counter()
+            b = loop(b, k, steps)
+            float(jnp.sum(b[0, 0, 0:1]))
+            compile_s = time.perf_counter() - t_c0
+        except Exception as e:
+            print(f"k={k} vmem={vmem_mb}MB: FAIL {type(e).__name__}: {str(e)[:300]}")
+            continue
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            b = loop(b, k, steps)
+            float(jnp.sum(b[0, 0, 0:1]))
+            best = min(best, time.perf_counter() - t0 - rt)
+        mcells = n**3 * steps / best / 1e6
+        print(
+            f"k={k} vmem={vmem_mb}MB: {mcells:,.0f} Mcells/s"
+            f"  ({best/steps*1e3:.3f} ms/iter, compile {compile_s:.0f}s)",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Probe20b: stability sweep of wrap-kernel temporal depth with raised
+scoped-VMEM budget (vmem_limit_bytes=100MB) at 512^3, interleaved repeats to
+separate chip contention from real depth effects."""
+from probe20 import wrap_step_vmem
+import functools, time
+import jax, jax.numpy as jnp
+from jax import lax
+from stencil_tpu.bin._common import host_round_trip_s
+
+def main():
+    rt = host_round_trip_s()
+    n = 512
+    loops = {}
+    for k in (3, 4, 5, 6, 8):
+        @functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)
+        def loop(b, k, s):
+            return lax.fori_loop(0, s // k, lambda _, x: wrap_step_vmem(x, k, 100), b)
+        loops[k] = loop
+    steps = 120
+    b = jnp.full((n, n, n), 0.5, jnp.float32)
+    # compile all first
+    for k, loop in loops.items():
+        b = loop(b, k, steps // k * k)
+        float(jnp.sum(b[0, 0, 0:1]))
+    best = {k: float("inf") for k in loops}
+    for rep in range(4):
+        for k, loop in loops.items():
+            s = steps // k * k
+            t0 = time.perf_counter()
+            b = loop(b, k, s)
+            float(jnp.sum(b[0, 0, 0:1]))
+            dt = (time.perf_counter() - t0 - rt) / s
+            best[k] = min(best[k], dt)
+            print(f"rep{rep} k={k}: {n**3/dt/1e6:,.0f} Mcells/s", flush=True)
+    print({k: f"{n**3/v/1e6:,.0f}" for k, v in best.items()})
+
+if __name__ == "__main__":
+    main()

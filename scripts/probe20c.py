@@ -1,0 +1,32 @@
+"""Probe20c: deeper wrap depths at 512^3, vmem 100MB."""
+from probe20 import wrap_step_vmem
+import functools, time
+import jax, jax.numpy as jnp
+from jax import lax
+from stencil_tpu.bin._common import host_round_trip_s
+
+def main():
+    rt = host_round_trip_s()
+    n = 512
+    b = jnp.full((n, n, n), 0.5, jnp.float32)
+    for k in (8, 10, 12, 16):
+        @functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)
+        def loop(bb, k, s):
+            return lax.fori_loop(0, s // k, lambda _, x: wrap_step_vmem(x, k, 100), bb)
+        s = 160 // k * k
+        try:
+            b = loop(b, k, s)
+            float(jnp.sum(b[0, 0, 0:1]))
+        except Exception as e:
+            print(f"k={k}: FAIL {str(e)[:200]}")
+            continue
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            b = loop(b, k, s)
+            float(jnp.sum(b[0, 0, 0:1]))
+            best = min(best, (time.perf_counter() - t0 - rt) / s)
+        print(f"k={k}: {n**3/best/1e6:,.0f} Mcells/s", flush=True)
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Probe21: wavefront (exchange-path) depth sweep at 512^3 with the raised
+scoped-VMEM budget — how deep should the halo-multiplier macro go now that
+m is no longer capped at 2 by the 16 MB default?  Uses the production model
+(Jacobi3D pallas_path='wavefront', one self-permuted chip, like bench.py)."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.bin._common import host_round_trip_s
+from stencil_tpu.models.jacobi import Jacobi3D
+
+
+def main():
+    rt = host_round_trip_s()
+    n = 512
+    dev = jax.devices()[0]
+    for m in (2, 3, 4, 6, 8, 12):
+        model = Jacobi3D(
+            n, n, n, devices=[dev], kernel_impl="pallas",
+            pallas_path="wavefront", temporal_k=m,
+        )
+        model.realize()
+        steps = 96 // m * m
+        try:
+            model.step(steps)
+            float(jnp.sum(model.dd.get_curr(model.h)))
+        except Exception as e:
+            print(f"m={m}: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)
+            continue
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            model.step(steps)
+            float(jnp.sum(model.dd.get_curr(model.h)))
+            best = min(best, (time.perf_counter() - t0 - rt) / steps)
+        z = model._wavefront_z_slabs
+        print(f"m={m} z_slabs={z}: {n**3/best/1e6:,.0f} Mcells/s", flush=True)
+        del model
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Probe21b: wavefront alias=True vs alias=False at deeper m — does the
+in-place aliasing serialize the deep-m pipeline?"""
+import functools, time
+import jax, jax.numpy as jnp
+import stencil_tpu.ops.jacobi_pallas as jp
+from stencil_tpu.bin._common import host_round_trip_s
+from stencil_tpu.models.jacobi import Jacobi3D
+
+orig = jp.jacobi_shell_wavefront_step
+
+def main():
+    rt = host_round_trip_s()
+    n = 512
+    dev = jax.devices()[0]
+    for alias in (True, False):
+        jp.jacobi_shell_wavefront_step = functools.partial(orig, alias=alias)
+        for m in (8, 12, 16):
+            model = Jacobi3D(n, n, n, devices=[dev], kernel_impl="pallas",
+                             pallas_path="wavefront", temporal_k=m)
+            model.realize()
+            steps = 96 // m * m
+            try:
+                model.step(steps)
+                float(jnp.sum(model.dd.get_curr(model.h)))
+            except Exception as e:
+                print(f"alias={alias} m={m}: FAIL {str(e)[:160]}", flush=True)
+                continue
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                model.step(steps)
+                float(jnp.sum(model.dd.get_curr(model.h)))
+                best = min(best, (time.perf_counter() - t0 - rt) / steps)
+            print(f"alias={alias} m={m}: {n**3/best/1e6:,.0f} Mcells/s", flush=True)
+            del model
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Probe22: is the wavefront path's ~75-80k ceiling caused by ragged
+(non-128-multiple lane) plane shapes?  Times the SAME wrap kernel at k=3 on
+512^3 vs shapes with ragged y/z extents."""
+from probe20 import wrap_step_vmem
+import functools, time
+import jax, jax.numpy as jnp
+from jax import lax
+from stencil_tpu.bin._common import host_round_trip_s
+
+def main():
+    rt = host_round_trip_s()
+    for shape in ((512,512,512), (516,516,516), (512,512,516), (512,516,512), (528,528,528), (512,512,640)):
+        k = 3
+        @functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)
+        def loop(bb, k, s):
+            return lax.fori_loop(0, s // k, lambda _, x: wrap_step_vmem(x, k, 100), bb)
+        b = jnp.full(shape, 0.5, jnp.float32)
+        s = 60
+        b = loop(b, k, s)
+        float(jnp.sum(b[0, 0, 0:1]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            b = loop(b, k, s)
+            float(jnp.sum(b[0, 0, 0:1]))
+            best = min(best, (time.perf_counter() - t0 - rt) / s)
+        cells = shape[0]*shape[1]*shape[2]
+        print(f"{shape}: {cells/best/1e6:,.0f} Mcells/s ({best*1e3:.2f} ms/iter)", flush=True)
+
+if __name__ == "__main__":
+    main()

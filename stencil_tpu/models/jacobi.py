@@ -222,17 +222,33 @@ class Jacobi3D:
             os.environ.get("STENCIL_Z_SLABS", "1") != "0"
             and getattr(self, "_wavefront_z_planned", False)
         )
+        # In-place aliasing serializes the deep-m pipeline (probe21b, 512^3:
+        # m=16 aliased 84k vs un-aliased 102k Mcells/s) — default to a fresh
+        # output buffer and trade one raw-sized HBM allocation for ~20%.
+        # The un-aliased kernel leaves high-x shell planes UNINITIALIZED;
+        # every consumer (next macro's exchange, stale-shell readback)
+        # rewrites the shell before reading it, so no garbage escapes.
+        # STENCIL_WAVEFRONT_ALIAS=1 restores the in-place form for
+        # memory-tight domains.
+        alias = os.environ.get("STENCIL_WAVEFRONT_ALIAS", "0") == "1"
         self._marks_shell_stale = True
         self._pallas_path = "wavefront"
         self._wavefront_z_slabs = z_slab_mode
         Xr, Yr, Zr = raw.x, raw.y, raw.z
+        # Ragged lane extents cripple the plane DMA (probe22: 512^2x516
+        # streams 30% slower than 512^3; 512^2x640 runs at full per-byte
+        # rate), so the z-slab route rounds the plane width up to a 128
+        # multiple with dead columns the kernel treats as outside the domain
+        # (z_valid).  Padding/unpadding happens once per step() dispatch,
+        # amortized over the device-side macro loop.
+        Zp = -(-Zr // 128) * 128 if z_slab_mode else Zr
 
         def per_shard(steps, raw_block):
             origin = jnp.stack(
                 [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
             )
             yz_d2 = pack_d2(
-                yz_dist2_plane(origin[1] - m, origin[2] - m, (raw.y, raw.z), gsize),
+                yz_dist2_plane(origin[1] - m, origin[2] - m, (raw.y, Zp), gsize),
                 gsize,
             )
 
@@ -241,7 +257,7 @@ class Jacobi3D:
                     b = halo_exchange_shard(b, shell, mesh_shape)
                     return jacobi_shell_wavefront_step(
                         b, depth, origin, yz_d2, gsize, interior_offset=m,
-                        interpret=interpret,
+                        alias=alias, interpret=interpret,
                     )
 
                 macros, rem = divmod(steps, m)
@@ -274,14 +290,14 @@ class Jacobi3D:
                 zs = jnp.concatenate([xext(yext(zlo)), xext(yext(zhi))], axis=1)
                 return jacobi_shell_wavefront_step(
                     b, depth, origin, yz_d2, gsize, interior_offset=m,
-                    z_slabs=zs, interpret=interpret,
+                    z_slabs=zs, z_valid=Zr, alias=alias, interpret=interpret,
                 )
 
             # prime the slab carry from the block's interior z boundaries,
             # transposed z-major (the one strided read per dispatch; all
-            # later slabs are kernel-emitted)
+            # later slabs are kernel-emitted), then lane-pad the block
             carry = (
-                raw_block,
+                jnp.pad(raw_block, ((0, 0), (0, 0), (0, Zp - Zr))),
                 jnp.concatenate(
                     [
                         jnp.swapaxes(raw_block[:, :, Zr - 2 * m : Zr - m], 1, 2),
@@ -294,7 +310,7 @@ class Jacobi3D:
             carry = lax.fori_loop(0, macros, lambda _, c: macro(m, c), carry)
             if rem:
                 carry = macro(rem, carry)
-            return carry[0]
+            return carry[0][:, :, :Zr]
 
         spec = P(*MESH_AXES)
 

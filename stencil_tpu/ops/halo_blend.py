@@ -102,8 +102,6 @@ def blend_slab(
     X, Y, Z = block.shape
     r = slab.shape[axis]
     if axis == 0:
-        from jax.experimental.pallas import tpu as pltpu
-
         # the aliased input stays in ANY memory space: the kernel never reads
         # it, so the planes being overwritten are not fetched into VMEM
         def kernel0(in_ref, slab_ref, out_ref):
@@ -114,7 +112,7 @@ def blend_slab(
             kernel0,
             grid=(r,),
             in_specs=[
-                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec((1, Y, Z), lambda g: (g, 0, 0)),
             ],
             out_specs=pl.BlockSpec((1, Y, Z), lambda g: (pos + g, 0, 0)),

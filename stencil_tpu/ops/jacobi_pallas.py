@@ -26,6 +26,7 @@ selects.
 
 from __future__ import annotations
 
+import os
 from typing import Tuple
 
 import jax
@@ -54,23 +55,42 @@ def yz_dist2_plane(origin_y, origin_z, shape_yz: Tuple[int, int], global_size) -
     return ((y - cy) ** 2)[:, None] + ((z - cz) ** 2)[None, :]
 
 
-#: the TPU scoped-VMEM hard limit the compiler enforces per kernel, and the
-#: stack margin its temporaries (rolls, selects) claim beyond the block
-#: buffers.  Calibrated against eight observed compile pass/fail points
-#: (probe10/10b/14/14b, v5e): e.g. wrap 512^2-plane k=3 passes (14.5 MB
-#: modeled), k=4 fails (16.6); wavefront 516^2-plane m=2 passes (15.0).
-#: (z-slab anchors, 516^2 m=2 +slabs: the ORIGINAL y-major 8-block layout
-#: modeled 17.11 MB vs a compiler-REPORTED 17.08 — rejected; the packed
-#: y-major 4-block layout REPORTED 16.08 — rejected by 80 KB; the current
-#: z-major 4-block layout models ~12.1 MB and compiles+runs on hardware at
-#: 74.5 Gcells/s, probe17.)
-_VMEM_LIMIT = 16_000_000
+#: The scoped-VMEM budget REQUESTED from the compiler
+#: (``CompilerParams(vmem_limit_bytes=...)``) and the stack margin its
+#: temporaries (rolls, selects) claim beyond the block buffers.  Mosaic's
+#: 16 MB default is only a default: v5e physically carries 128 MB of VMEM and
+#: raising the request to 100 MB compiles and RUNS FASTER at every depth
+#: probed (scripts/probe20*, 512^3 f32: k=3 97 -> k=12 190 -> k=16 ~200
+#: Gcells/s; k=32 at a 120 MB request regresses to 152 — leave headroom for
+#: the pipeline's double buffers).  The r04 calibration anchors (16 MB
+#: pass/fail points, probe10/14/17) describe the DEFAULT budget and survive
+#: as the behavior when ``STENCIL_VMEM_LIMIT_BYTES`` forces the old value.
+_VMEM_BUDGET_DEFAULT = 100 * 1024 * 1024
 _VMEM_STACK_MARGIN = 3_000_000
 
-#: deepest depth validated on hardware; beyond it each level adds < 5%
-#: (probe10b: 256^3 k=6 134.0 -> k=8 135.2 Gcells/s) so there is no hurry to
-#: re-qualify deeper wavefronts on new toolchains
-_WRAP_MAX_K = 6
+
+def _vmem_budget() -> int:
+    """Requested scoped-VMEM bytes; ``STENCIL_VMEM_LIMIT_BYTES`` overrides
+    (read per call so tests can force an over-budget compile)."""
+    return int(os.environ.get("STENCIL_VMEM_LIMIT_BYTES", _VMEM_BUDGET_DEFAULT))
+
+#: deepest depth validated on hardware and the measured plateau: probe20b/c/d
+#: (512^3, 100 MB budget) k=8 128-132, k=12 190, k=16 142-202, k=20 190,
+#: k=24 190, k=32 152 Gcells/s — the plateau spans ~12-24 with run-to-run
+#: contention noise; 16 sits mid-plateau at modest (40 MB) VMEM
+_WRAP_MAX_K = 16
+
+
+def _tpu_compiler_params(interpret: bool):
+    """kwargs dict requesting the calibrated scoped-VMEM budget — empty in
+    interpret mode (no Mosaic, nothing to budget)."""
+    if interpret:
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    return {
+        "compiler_params": pltpu.CompilerParams(vmem_limit_bytes=_vmem_budget())
+    }
 
 
 def _padded_plane_bytes(plane_y: int, plane_z: int, itemsize: int) -> int:
@@ -112,7 +132,7 @@ def wavefront_vmem_fits(
     d2_itemsize: int = 4,
 ) -> bool:
     est = wavefront_vmem_bytes(k, plane_y, plane_z, itemsize, z_slabs, d2_itemsize)
-    return est + _VMEM_STACK_MARGIN <= _VMEM_LIMIT
+    return est + _VMEM_STACK_MARGIN <= _vmem_budget()
 
 
 def pack_d2(yz_d2: jax.Array, global_size) -> jax.Array:
@@ -131,7 +151,7 @@ def warn_if_over_vmem_budget(k: int, plane_y: int, plane_z: int, itemsize: int) 
 
         log_warn(
             f"temporal depth {k} models {est / 1e6:.1f} MB of VMEM blocks "
-            f"(+{_VMEM_STACK_MARGIN / 1e6:.0f} stack > {_VMEM_LIMIT / 1e6:.0f} limit); "
+            f"(+{_VMEM_STACK_MARGIN / 1e6:.0f} stack > {_vmem_budget() / 1e6:.0f} budget); "
             "expect a compile failure on real TPU (fine in interpret mode)"
         )
 
@@ -256,6 +276,7 @@ def jacobi_wrap_step(
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
         scratch_shapes=[pltpu.VMEM((k, 2, Y, Z), block.dtype)],
         interpret=interpret,
+        **_tpu_compiler_params(interpret),
     )(block, d2.astype(jnp.int32))
 
 
@@ -277,13 +298,24 @@ def jacobi_shell_wavefront_step(
     # pass, ~64x amplification — scripts/probe12d).  Rows [0, s) = my low
     # halo (zlo), [s, 2s) = my high halo (zhi) — ONE packed buffer, stored
     # z-major so each streamed (1, 2s, Yr) block pads to (8, lanes) instead
-    # of (sublanes, 128): ~20 KB/block vs 266, the difference that fits
-    # 516^2 planes under the 16 MB scoped-VMEM limit.  The kernel transposes
+    # of (sublanes, 128): ~20 KB/block vs 266 — a 13x VMEM saving per
+    # double-buffered block that still matters for deep-m budgets (and was
+    # what fit 516^2 planes under Mosaic's old 16 MB default, kept reachable
+    # via STENCIL_VMEM_LIMIT_BYTES).  The kernel transposes
     # the small block in VMEM, patches the z columns of every streamed
     # plane, and, when set, ALSO emits the next macro step's outgoing slabs
     # in the same layout, returning (out, z_out) with z_out rows [0, s) =
     # my top interior cols [Zr-2s, Zr-s) (the -z-bound message) and
     # [s, 2s) = my bottom interior cols [s, 2s) (the +z-bound message).
+    z_valid: int = None,  # logical z extent of the raw planes (shell incl.);
+    # columns [z_valid, Zr) are DEAD LANE PADDING that rounds the plane width
+    # up to a 128 multiple.  Ragged lane extents cripple the plane DMA
+    # (probe22: 512x512x516 streams 30% slower than 512x512x512 while
+    # 512x512x640 runs at full per-byte rate), so the caller pads the array
+    # and the kernel treats [z_valid, Zr) as outside the domain.  Dead-column
+    # garbage rolls into halo column 0 / z_valid-1 at level 1 — columns that
+    # are only valid at level 0 anyway, so the shrinking-validity argument is
+    # unchanged: level s remains valid on [s, z_valid - s).
 ) -> jax.Array:
     """``m`` Jacobi levels over an m-shell-carrying shard in ONE pass — the
     multi-device temporal-blocking path.
@@ -313,10 +345,17 @@ def jacobi_shell_wavefront_step(
     from jax.experimental.pallas import tpu as pltpu
 
     Xr, Yr, Zr = raw.shape
+    zv = Zr if z_valid is None else z_valid
     s_off = m if interior_offset is None else interior_offset
     # raw must carry a shell at least m wide plus >= 1 interior cell per axis
-    assert 1 <= m <= s_off and 2 * s_off < min(Xr, Yr, Zr), (m, s_off, raw.shape)
+    assert 1 <= m <= s_off and 2 * s_off < min(Xr, Yr, zv), (m, s_off, raw.shape, zv)
+    assert zv <= Zr, (zv, Zr)
     gx = global_size[0]
+    # the in-kernel lax.rem relies on its operand being non-negative:
+    # i - s - s_off >= -2*s_off > -gx, so one added gx suffices.  Enforce the
+    # precondition instead of assuming it (an x-unsharded mesh with a deep
+    # explicit temporal_k could otherwise silently mis-force shell planes).
+    assert 2 * s_off < gx, (s_off, gx)
     hot_x, cold_x, in_r2 = sphere_params(gx)
 
     roll = _make_roll(interpret)
@@ -339,7 +378,7 @@ def jacobi_shell_wavefront_step(
             for j in range(s_off):
                 vals = jnp.where(col == j, zst[:, j][:, None], vals)
                 vals = jnp.where(
-                    col == Zr - s_off + j, zst[:, s_off + j][:, None], vals
+                    col == zv - s_off + j, zst[:, s_off + j][:, None], vals
                 )
         for s in range(1, m + 1):
             prev = ring[s - 1, i % 2]  # level-(s-1) plane i-s-1
@@ -372,7 +411,7 @@ def jacobi_shell_wavefront_step(
             # here; the caller's slab extensions overwrite them), packed
             # [(-z)-bound message | (+z)-bound message], z-major
             emit = jnp.concatenate(
-                [vals[:, Zr - 2 * s_off : Zr - s_off], vals[:, s_off : 2 * s_off]],
+                [vals[:, zv - 2 * s_off : zv - s_off], vals[:, s_off : 2 * s_off]],
                 axis=1,
             )  # (Yr, 2s)
             zout_ref[0] = jnp.swapaxes(emit, 0, 1)
@@ -412,6 +451,7 @@ def jacobi_shell_wavefront_step(
         input_output_aliases={1: 0} if alias else {},
         scratch_shapes=[pltpu.VMEM((m, 2, Yr, Zr), raw.dtype)],
         interpret=interpret,
+        **_tpu_compiler_params(interpret),
     )(*args)
 
 
@@ -535,6 +575,7 @@ def jacobi_slab_step(
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
         scratch_shapes=[pltpu.VMEM((2, Y, Z), block.dtype)],
         interpret=interpret,
+        **_tpu_compiler_params(interpret),
     )(
         origin.astype(jnp.int32),
         block,
@@ -610,4 +651,5 @@ def jacobi_plane_step(
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
         scratch_shapes=[pltpu.VMEM((2, Y, Z), block.dtype)],
         interpret=interpret,
+        **_tpu_compiler_params(interpret),
     )(origin.astype(jnp.int32), block, yz_d2.astype(jnp.int32))

@@ -38,7 +38,7 @@ def mean6_shell_wavefront_step(
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    from stencil_tpu.ops.jacobi_pallas import _make_roll
+    from stencil_tpu.ops.jacobi_pallas import _make_roll, _tpu_compiler_params
 
     Xr, Yr, Zr = raw.shape
     assert 1 <= m <= shell_width and 2 * shell_width < min(Xr, Yr, Zr), (
@@ -75,6 +75,7 @@ def mean6_shell_wavefront_step(
         input_output_aliases={0: 0},
         scratch_shapes=[pltpu.VMEM((m, 2, Yr, Zr), raw.dtype)],
         interpret=interpret,
+        **_tpu_compiler_params(interpret),
     )(raw)
 
 
@@ -84,6 +85,8 @@ def mean6_plane_step(
     """One mean-of-6-face-neighbors iteration over a shell-carrying block."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from stencil_tpu.ops.jacobi_pallas import _tpu_compiler_params
 
     X, Y, Z = block.shape
     # every side needs >= 1 shell cell: the distance-1 reads and the
@@ -136,4 +139,5 @@ def mean6_plane_step(
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
         scratch_shapes=[pltpu.VMEM((2, Y, Z), block.dtype)],
         interpret=interpret,
+        **_tpu_compiler_params(interpret),
     )(block)

@@ -164,7 +164,8 @@ def test_bf16_wrap_and_wavefront_paths():
 def test_choose_temporal_k():
     from stencil_tpu.ops.jacobi_pallas import choose_temporal_k
 
-    assert choose_temporal_k((512, 512, 512), 4) == 3
+    # 100 MB budget fits the plateau cap (_WRAP_MAX_K) at 512^3
+    assert choose_temporal_k((512, 512, 512), 4) == 16
     assert choose_temporal_k((4, 64, 64), 4) == 2  # X//2 caps
     assert choose_temporal_k((2, 64, 64), 4) == 1
     # budget caps: huge planes leave no VMEM for the ring
@@ -172,6 +173,18 @@ def test_choose_temporal_k():
     assert choose_temporal_k((512, 128, 128), 4, requested=2) == 2
     with pytest.raises(ValueError):
         choose_temporal_k((4, 64, 64), 4, requested=3)
+    # the env override restores the r04 16 MB default-budget calibration
+    import os
+
+    prior = os.environ.get("STENCIL_VMEM_LIMIT_BYTES")
+    os.environ["STENCIL_VMEM_LIMIT_BYTES"] = "16000000"
+    try:
+        assert choose_temporal_k((512, 512, 512), 4) == 3
+    finally:
+        if prior is None:
+            del os.environ["STENCIL_VMEM_LIMIT_BYTES"]
+        else:
+            os.environ["STENCIL_VMEM_LIMIT_BYTES"] = prior
 
 
 def test_wrap_fast_path_matches_jnp_single_device():

@@ -1,0 +1,123 @@
+"""Probe23: user-kernel stream engine throughput at 512^3 on the real chip.
+
+The round-5 'done' bar: a NEW stencil written only against the public API
+(make_step(engine='stream')) reaches >= 50% of the jacobi plane path's
+measured throughput.  Times:
+  - jacobi bespoke shell/plane route (the baseline the criterion names)
+  - stream engine, mean6 kernel, plane route (shell 1)
+  - stream engine, mean6 kernel, wavefront (halo multiplier 8)
+  - stream engine, 27-point weighted kernel, plane + wavefront routes
+  - stream engine, variable-coefficient diffusion (2 fields), wavefront
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.bin._common import host_round_trip_s
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.models.jacobi import Jacobi3D
+
+N = 512
+
+
+def mean6_kernel(views, info):
+    return {
+        name: (
+            src.sh(-1, 0, 0) + src.sh(0, -1, 0) + src.sh(0, 0, -1)
+            + src.sh(1, 0, 0) + src.sh(0, 1, 0) + src.sh(0, 0, 1)
+        ) / 6.0
+        for name, src in views.items()
+    }
+
+
+def stencil27_kernel(views, info):
+    src = views["u"]
+    acc = 0.0
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            for dz in (-1, 0, 1):
+                w = 1.0 / (2.0 ** (abs(dx) + abs(dy) + abs(dz)))
+                acc = acc + w * src.sh(dx, dy, dz)
+    return {"u": acc / 7.0}
+
+
+def vc_diffusion_kernel(views, info):
+    u, c = views["u"], views["c"]
+    lap = (
+        u.sh(-1, 0, 0) + u.sh(1, 0, 0) + u.sh(0, -1, 0) + u.sh(0, 1, 0)
+        + u.sh(0, 0, -1) + u.sh(0, 0, 1) - 6.0 * u.center()
+    )
+    return {"u": u.center() + c.center() * lap}
+
+
+def make_domain(names, mult=1):
+    dd = DistributedDomain(N, N, N)
+    dd.set_radius(Radius.constant(1))
+    dd.set_devices(jax.devices()[:1])
+    if mult != 1:
+        dd.set_halo_multiplier(mult)
+    hs = [dd.add_data(n) for n in names]
+    dd.realize()
+    for h in hs:
+        dd.init_by_coords(h, lambda x, y, z: jnp.sin(0.01 * (x + y + z)))
+    return dd, hs
+
+
+def timed(label, dd, step, rt, steps=64):
+    try:
+        dd.run_step(step, steps)
+        dd.block_until_ready()
+        float(jnp.sum(dd.get_curr(dd._handles[0])[0, 0, 0:1]))
+    except Exception as e:
+        print(f"{label}: FAIL {type(e).__name__}: {str(e)[:200]}", flush=True)
+        return
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        dd.run_step(step, steps)
+        float(jnp.sum(dd.get_curr(dd._handles[0])[0, 0, 0:1]))
+        best = min(best, (time.perf_counter() - t0 - rt) / steps)
+    plan = getattr(step, "_stream_plan", None)
+    print(f"{label}: {N**3/best/1e6:,.0f} Mcells/s  (plan={plan})", flush=True)
+
+
+def main():
+    rt = host_round_trip_s()
+
+    # baseline: jacobi bespoke plane/shell route
+    jm = Jacobi3D(N, N, N, devices=jax.devices()[:1], kernel_impl="pallas",
+                  pallas_path="shell")
+    jm.realize()
+    jm.step(64)
+    float(jnp.sum(jm.dd.get_curr(jm.h)[0, 0, 0:1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jm.step(64)
+        float(jnp.sum(jm.dd.get_curr(jm.h)[0, 0, 0:1]))
+        best = min(best, (time.perf_counter() - t0 - rt) / 64)
+    base = N**3 / best / 1e6
+    print(f"jacobi bespoke shell/plane route: {base:,.0f} Mcells/s", flush=True)
+    del jm
+
+    for label, names, kern, mult in (
+        ("stream mean6 plane (shell 1)", ["u"], mean6_kernel, 1),
+        ("stream mean6 wavefront (mult 8)", ["u"], mean6_kernel, 8),
+        ("stream mean6 wavefront (mult 16)", ["u"], mean6_kernel, 16),
+        ("stream 27pt plane (shell 1)", ["u"], stencil27_kernel, 1),
+        ("stream 27pt wavefront (mult 8)", ["u"], stencil27_kernel, 8),
+        ("stream vc-diffusion wavefront (mult 8)", ["u", "c"], vc_diffusion_kernel, 8),
+    ):
+        dd, hs = make_domain(names, mult)
+        step = dd.make_step(kern, engine="stream", x_radius=1)
+        timed(label, dd, step, rt)
+        del dd, step
+
+
+if __name__ == "__main__":
+    main()

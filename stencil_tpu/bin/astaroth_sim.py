@@ -46,11 +46,14 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--schedule",
-        choices=["per-step", "wavefront"],
-        default="per-step",
-        help="per-step: reference parity (exchange every iteration); "
-        "wavefront: exchange every m<=3 steps, m-level temporal kernel "
-        "(same field values, ~1/m the traffic)",
+        choices=["auto", "per-step", "wavefront"],
+        default="auto",
+        help="auto (default): exchange every m<=3 steps with an m-level "
+        "temporal wavefront kernel when shards are even (same field values "
+        "up to last-ulp fusion effects, ~1/m the traffic; ~2.6x at 512^3), "
+        "per-step otherwise; per-step: reference exchange-cadence parity "
+        "(one exchange per iteration, modeling Astaroth's real comm volume); "
+        "wavefront: force the temporal schedule (error when not viable)",
     )
     args = p.parse_args(argv)
 

@@ -659,7 +659,19 @@ class DistributedDomain:
         return 0
 
     # --- fused step builder ---------------------------------------------------
-    def make_step(self, kernel: StepKernel, overlap: bool = True, donate: bool = True):
+    def make_step(
+        self,
+        kernel: StepKernel,
+        overlap: bool = True,
+        donate: bool = True,
+        engine: str = "xla",
+        x_radius: int = None,
+        stream_path: str = "auto",  # stream engine route: auto|plane|wavefront
+        separable: bool = False,  # stream engine: kernel is correct on view
+        # subsets (each field reads only itself) -> per-field passes may
+        # replace the joint pass when many fields blow the VMEM model
+        interpret: bool = False,  # stream engine only: pallas interpret mode
+    ):
         """Build ``step(curr) -> next`` fusing exchange + compute.
 
         With a halo multiplier ``k`` (``set_halo_multiplier``) each built step
@@ -672,8 +684,38 @@ class DistributedDomain:
         carries no dependency on the ppermutes — XLA schedules them
         concurrently.  ``overlap=False`` computes the whole region after the
         exchange (jacobi3d.cu:312-329 --no-overlap).
+
+        ``engine`` selects the compute lowering for the SAME kernel callable:
+
+        * ``"xla"`` — shifted-slice formulation (this method's body).  Fully
+          general (padded shards, N-D data, any shifts) but each shifted
+          operand re-reads the block from HBM (~6 reads/cell for a 7-point
+          stencil).
+        * ``"stream"`` — the plane-streaming engine (``ops/stream.py``):
+          x-planes ride a VMEM ring so each HBM plane is read once per pass,
+          and a uniform shell >= 2 upgrades to the temporal wavefront (m
+          levels per pass).  Requires elementwise kernels with x shifts
+          within ``x_radius`` (default: the max user radius), even shards,
+          no N-D data.  This is how USER stencils reach the flagship paths'
+          speed — the reference's user-kernel model (accessor.hpp:13-40)
+          where the cache hierarchy is an explicit plane ring.  ``overlap``
+          is not meaningful there (the macro is one fused pass).
         """
         assert self._realized
+        if engine == "stream":
+            from stencil_tpu.ops.stream import make_stream_step
+
+            if x_radius is None:
+                x_radius = max(
+                    max(self._radius.lo()[ax], self._radius.hi()[ax])
+                    for ax in range(3)
+                )
+            return make_stream_step(
+                self, kernel, x_radius=x_radius, path=stream_path,
+                separable=separable, interpret=interpret,
+            )
+        if engine != "xla":
+            raise ValueError(f"unknown engine {engine!r}")
         from stencil_tpu.core.geometry import exterior_of, shrink_by_radius
 
         n = self._spec.sz
@@ -828,3 +870,7 @@ class DistributedDomain:
         per-dispatch overhead would otherwise dominate small steps.
         """
         self._curr = step_fn(self._curr, steps)
+        # streaming-engine steps advance interiors only; the carried shell
+        # goes stale and raw readback must re-exchange first
+        if getattr(step_fn, "_marks_shell_stale", False):
+            self.mark_shell_stale()

@@ -16,6 +16,14 @@ magnetohydrodynamics code used to study compute/communication overlap:
 The reference keeps 3 more quantities commented out (astaroth_sim.cu:193-196);
 ``num_quantities`` makes that scaling axis explicit here (the real Astaroth
 exchanges 8 fields).
+
+The pallas path runs ``_kernel`` VERBATIM under the plane-streaming engine
+(``ops/stream.py``): the default ``schedule="auto"`` upgrades to the m-level
+temporal wavefront (m <= 3 — the depth a radius-3 shell feeds for distance-1
+reads) whenever shards are even, ~2.6x faster at 512^3 than the per-step
+schedule; ``--schedule per-step`` restores exact exchange-cadence parity with
+the reference (one exchange per iteration, modeling Astaroth's real
+communication volume).
 """
 
 from __future__ import annotations
@@ -44,12 +52,15 @@ class AstarothSim:
         dtype=jnp.float32,
         kernel_impl: str = "jnp",  # "jnp" | "pallas" (plane streaming)
         interpret: bool = False,
-        schedule: str = "per-step",  # "per-step" (reference parity: exchange
-        # every iteration, modeling Astaroth's comm volume) | "wavefront"
-        # (opt-in: the radius-3 shell already feeds 3 levels of the
-        # distance-1 stencil, so exchange every m <= 3 steps and run an
-        # m-level wavefront kernel — same field values up to last-ulp
-        # fusion effects, ~1/m the traffic)
+        schedule: str = "auto",  # "auto" (DEFAULT: the radius-3 shell
+        # already feeds 3 levels of the distance-1 stencil, so exchange
+        # every m <= 3 steps and run an m-level wavefront kernel — same
+        # field values up to last-ulp fusion effects, ~1/m the traffic;
+        # falls back to per-step when the wavefront is not viable, e.g.
+        # uneven sizes) | "wavefront" (forced: raises when not viable) |
+        # "per-step" (reference parity escape hatch: exchange every
+        # iteration, modeling Astaroth's real communication volume —
+        # astaroth_sim.cu:223-274)
     ):
         self.dd = DistributedDomain(x, y, z)
         self.dd.set_radius(Radius.constant(3))  # astaroth_sim.cu:184
@@ -63,12 +74,11 @@ class AstarothSim:
         self.overlap = overlap
         self.kernel_impl = kernel_impl
         self.interpret = interpret
-        if schedule not in ("per-step", "wavefront"):
+        if schedule not in ("auto", "per-step", "wavefront"):
             raise ValueError(f"unknown schedule {schedule!r}")
         self.schedule = schedule
         self._step = None
         self._marks_shell_stale = False
-        self._wavefront_m = 0
 
     def realize(self) -> None:
         self.dd.realize()
@@ -76,6 +86,10 @@ class AstarothSim:
         for h in self.handles:
             self.dd.init_by_coords(h, lambda x, y, z: jnp.sin(w * (x + y + z)))
         if self.kernel_impl == "pallas":
+            # the plane-streaming ENGINE (ops/stream.py) runs the model's own
+            # _kernel verbatim: per-step exchange = plane route, wavefront
+            # schedule = the engine's m-level temporal route (m <= 3, the
+            # depth the radius-3 shell feeds for distance-1 reads)
             if self.dd.halo_multiplier() != 1:
                 raise ValueError("pallas path requires halo multiplier 1")
             if not self.overlap:
@@ -83,123 +97,41 @@ class AstarothSim:
                     "overlap=False has no meaning for the fused pallas step; "
                     "use kernel_impl='jnp' for overlap comparisons"
                 )
-            if self.schedule == "wavefront":
-                self._step = self._make_wavefront_step()
-            else:
-                self._step = self._make_pallas_step()
+            path = {"auto": "auto", "wavefront": "wavefront", "per-step": "plane"}[
+                self.schedule
+            ]
+            self._step = self.dd.make_step(
+                self._kernel,
+                engine="stream",
+                x_radius=1,
+                stream_path=path,
+                # _kernel updates each field from itself only, so many-field
+                # runs may stream per-field at full wavefront depth
+                separable=True,
+                interpret=self.interpret,
+            )
         else:
             if self.schedule == "wavefront":
                 raise ValueError("schedule='wavefront' requires kernel_impl='pallas'")
             self._step = self.dd.make_step(self._kernel, overlap=self.overlap)
 
-    def _wrap_step_fn(self, per_shard):
-        """Shared jit/shard_map wrapper for the pallas step makers:
-        ``per_shard(steps, *blocks) -> blocks`` over P('x','y','z') shards.
-        check_vma off: pallas_call outputs carry no vma annotation."""
-        from functools import partial
-
-        import jax
-        from jax.sharding import PartitionSpec as P
-
-        from stencil_tpu.parallel.mesh import MESH_AXES
-
-        dd = self.dd
-        names = [h.name for h in self.handles]
-        spec = P(*MESH_AXES)
-
-        @partial(jax.jit, static_argnums=1, donate_argnums=0)
-        def step(curr, steps: int = 1):
-            fn = jax.shard_map(
-                partial(per_shard, steps),
-                mesh=dd.mesh,
-                in_specs=tuple(spec for _ in names),
-                out_specs=tuple(spec for _ in names),
-                check_vma=False,
-            )
-            outs = fn(*[curr[k] for k in names])
-            return dict(zip(names, outs))
-
-        return step
-
-    def _make_pallas_step(self):
-        """Plane-streaming mean-of-6 kernel (ops/plane_stencil) fused with the
-        exchange — one HBM read + one write per plane per iteration."""
-        from jax import lax
-
-        from stencil_tpu.ops.exchange import halo_exchange_multi
-        from stencil_tpu.ops.plane_stencil import mean6_plane_step
-        from stencil_tpu.parallel.mesh import MESH_AXES
-
-        dd = self.dd
-        shell = dd._shell_radius
-        lo, hi = shell.lo(), shell.hi()
-        mesh_shape = tuple(dd.mesh.shape[a] for a in MESH_AXES)
-        valid_last = dd._valid_last
-        interpret = self.interpret
-
-        def per_shard(steps, *blocks):
-            def body(_, bs):
-                # joint exchange: ≤6 permutes for any field count
-                bs = halo_exchange_multi(bs, shell, mesh_shape, valid_last=valid_last)
-                return tuple(
-                    mean6_plane_step(b, lo, hi, interpret=interpret) for b in bs
-                )
-
-            return lax.fori_loop(0, steps, body, tuple(blocks))
-
-        return self._wrap_step_fn(per_shard)
-
-    def _make_wavefront_step(self):
-        """Opt-in temporal schedule: one radius-3 shell exchange feeds an
-        m-level mean6 wavefront (m <= 3, VMEM-fitted) — the per-step
-        schedule's field values up to last-ulp fusion effects, at ~1/m the
-        exchange traffic and HBM passes.  Requires even (unpadded) sizes (the wavefront kernel has no
-        padded-axis form)."""
-        from jax import lax
-
-        from stencil_tpu.ops.exchange import halo_exchange_multi
-        from stencil_tpu.ops.jacobi_pallas import wavefront_vmem_fits
-        from stencil_tpu.ops.plane_stencil import mean6_shell_wavefront_step
-        from stencil_tpu.parallel.mesh import MESH_AXES
-
-        dd = self.dd
-        if any(v is not None for v in dd._valid_last):
-            raise ValueError("schedule='wavefront' requires even (unpadded) sizes")
-        shell = dd._shell_radius
-        s_w = shell.lo().x  # uniform radius 3
-        raw = dd.local_spec().raw_size()
-        itemsize = self.handles[0].dtype.itemsize
-        m = 1
-        for cand in range(2, s_w + 1):
-            if wavefront_vmem_fits(cand, raw.y, raw.z, itemsize, d2_itemsize=0):
-                m = cand
-        self._wavefront_m = m
-        mesh_shape = tuple(dd.mesh.shape[a] for a in MESH_AXES)
-        valid_last = dd._valid_last
-        interpret = self.interpret
-        self._marks_shell_stale = True
-
-        def per_shard(steps, *blocks):
-            def macro(depth, bs):
-                bs = halo_exchange_multi(bs, shell, mesh_shape, valid_last=valid_last)
-                return tuple(
-                    mean6_shell_wavefront_step(b, depth, s_w, interpret=interpret)
-                    for b in bs
-                )
-
-            macros, rem = divmod(steps, m)
-            bs = lax.fori_loop(0, macros, lambda _, b: macro(m, b), tuple(blocks))
-            if rem:
-                bs = macro(rem, bs)
-            return bs
-
-        return self._wrap_step_fn(per_shard)
+    @property
+    def _wavefront_m(self) -> int:
+        """CURRENT wavefront depth (0 = per-step) — read from the live
+        stream plan, which the engine's runtime VMEM fallback may have
+        stepped down after realize()."""
+        plan = getattr(self._step, "_stream_plan", None)
+        if plan is not None and plan["route"] == "wavefront":
+            return plan["m"]
+        return 0
 
     def _kernel(self, views, info):
+        # iterate the views HANDED IN (not self.handles): each field updates
+        # from itself only, so the kernel is correct on any subset — the
+        # separability the stream engine exploits for per-field passes
         out = {}
-        for h in self.handles:
-            src = views[h.name]
-            out[h.name] = (
+        for name, src in views.items():
+            out[name] = (
                 src.sh(-1, 0, 0)
                 + src.sh(0, -1, 0)
                 + src.sh(0, 0, -1)

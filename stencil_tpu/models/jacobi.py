@@ -197,15 +197,17 @@ class Jacobi3D:
         from jax import lax
         from jax.sharding import PartitionSpec as P
 
-        from stencil_tpu.ops.exchange import (
-            _shift_from_high,
-            _shift_from_low,
-            halo_exchange_shard,
-        )
+        from stencil_tpu.ops.exchange import halo_exchange_shard
         from stencil_tpu.ops.jacobi_pallas import (
             jacobi_shell_wavefront_step,
             pack_d2,
             yz_dist2_plane,
+        )
+        from stencil_tpu.ops.stream import (
+            lane_pad_width,
+            make_slab_extenders,
+            permute_and_extend_z_slabs,
+            prime_z_slabs,
         )
         from stencil_tpu.parallel.mesh import MESH_AXES
 
@@ -241,7 +243,7 @@ class Jacobi3D:
         # multiple with dead columns the kernel treats as outside the domain
         # (z_valid).  Padding/unpadding happens once per step() dispatch,
         # amortized over the device-side macro loop.
-        Zp = -(-Zr // 128) * 128 if z_slab_mode else Zr
+        Zp = lane_pad_width(Zr) if z_slab_mode else Zr
 
         def per_shard(steps, raw_block):
             origin = jnp.stack(
@@ -266,45 +268,26 @@ class Jacobi3D:
                     b = macro_plain(rem, b)
                 return b
 
-            def yext(S):
-                # my slab's y-shell rows (last axis in the z-major layout)
-                # hold the y neighbors' top/bottom interior rows of the SAME
-                # slab (post z-permute, so the yz-diagonal's data is already
-                # aboard)
-                lo = _shift_from_low(S[:, :, Yr - 2 * m : Yr - m], MESH_AXES[1], mesh_shape[1])
-                hi = _shift_from_high(S[:, :, m : 2 * m], MESH_AXES[1], mesh_shape[1])
-                return S.at[:, :, 0:m].set(lo).at[:, :, Yr - m : Yr].set(hi)
-
-            def xext(S):
-                lo = _shift_from_low(S[Xr - 2 * m : Xr - m], MESH_AXES[0], mesh_shape[0])
-                hi = _shift_from_high(S[m : 2 * m], MESH_AXES[0], mesh_shape[0])
-                return S.at[0:m].set(lo).at[Xr - m : Xr].set(hi)
+            # slab y/x extension (corner propagation) + z permute + priming
+            # are shared with the generic engine (ops/stream.py helpers)
+            yext, xext = make_slab_extenders(Xr, Yr, m, mesh_shape)
 
             def macro(depth, carry):
                 b, zout = carry
                 # x/y shells in the array (cheap: planes / sublane rows)
                 b = halo_exchange_shard(b, shell, mesh_shape, axes=(0, 1))
                 # zout is z-major (Xr, 2m, Yr): [(-z)-bound | (+z)-bound]
-                zlo = _shift_from_low(zout[:, 0:m, :], MESH_AXES[2], mesh_shape[2])
-                zhi = _shift_from_high(zout[:, m : 2 * m, :], MESH_AXES[2], mesh_shape[2])
-                zs = jnp.concatenate([xext(yext(zlo)), xext(yext(zhi))], axis=1)
+                zs = permute_and_extend_z_slabs(zout, m, mesh_shape, yext, xext)
                 return jacobi_shell_wavefront_step(
                     b, depth, origin, yz_d2, gsize, interior_offset=m,
                     z_slabs=zs, z_valid=Zr, alias=alias, interpret=interpret,
                 )
 
-            # prime the slab carry from the block's interior z boundaries,
-            # transposed z-major (the one strided read per dispatch; all
-            # later slabs are kernel-emitted), then lane-pad the block
+            # prime the slab carry from the block's interior z boundaries
+            # (z-major), then lane-pad the block
             carry = (
                 jnp.pad(raw_block, ((0, 0), (0, 0), (0, Zp - Zr))),
-                jnp.concatenate(
-                    [
-                        jnp.swapaxes(raw_block[:, :, Zr - 2 * m : Zr - m], 1, 2),
-                        jnp.swapaxes(raw_block[:, :, m : 2 * m], 1, 2),
-                    ],
-                    axis=1,
-                ),
+                prime_z_slabs(raw_block, Zr, m),
             )
             macros, rem = divmod(steps, m)
             carry = lax.fori_loop(0, macros, lambda _, c: macro(m, c), carry)

@@ -1,0 +1,729 @@
+"""Plane-streaming engine for USER step kernels — fast by default.
+
+In the reference, the stencil kernel is USER code: apps write plain CUDA
+through ``Accessor`` (accessor.hpp:13-40, jacobi3d.cu:65-108,
+astaroth_sim.cu:65-83) and the GPU cache hierarchy gives every such kernel
+operand reuse for free.  The TPU analog of that cache reuse is an explicit
+VMEM plane ring — which rounds 1-4 hard-coded into the jacobi/astaroth fast
+paths.  This module is the generalization: it runs the SAME ``StepKernel``
+signature that ``make_step``'s XLA route runs — ``views[name].sh(dx,dy,dz)``
+reads plus ``info.coords()`` — but streams x-planes through VMEM so each HBM
+plane is read once per pass instead of once per shifted operand (the XLA
+slice formulation re-reads the block ~6x, measured 5-7.5 Gcells/s at 512^3
+vs ~40+ for the streamed form).
+
+Two routes, chosen by ``make_stream_step``:
+
+* **plane** — one level per pass: exchange the shell, then stream planes
+  with a ``2r``-deep ring (``r`` = the kernel's declared x read distance).
+  Works for any per-axis shell widths and any ``r >= 1``.
+* **wavefront** — ``m`` levels per pass over an ``s``-wide-shell shard
+  (``m <= s // r``, ``r == 1`` only): each HBM plane is read and written
+  once per ``m`` iterations (~``8/m`` B/cell), the temporal blocking that
+  makes the flagship paths beat the bandwidth roofline.  Supports the z-slab
+  form (z halos never touch the tiled array — see
+  ``jacobi_shell_wavefront_step``'s layout notes) including the lane-padding
+  of ragged plane widths, generalized to any field count.
+
+The engine is bit-compatible with the XLA route: both call the user kernel
+with the same per-cell arithmetic, so outputs agree exactly (modulo compiler
+excess precision, which the interpret-mode tests pin).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.ops.jacobi_pallas import (
+    _make_roll,
+    _padded_plane_bytes,
+    _tpu_compiler_params,
+    _vmem_budget,
+    _VMEM_STACK_MARGIN,
+    _WRAP_MAX_K,
+)
+
+
+class PlaneView:
+    """Resident-plane window for one quantity inside a streaming kernel.
+
+    ``sh(dx, dy, dz)`` mirrors ``ShardView.sh`` (the reference's
+    ``src[o + Dim3(dx,dy,dz)]`` Accessor read, accessor.hpp:27-40): the
+    x offset selects one of the ``2r+1`` VMEM-resident planes, the y/z
+    offsets are in-plane rotates.  Rotate wraparound at the plane edges only
+    contaminates shell cells the validity contract already sacrifices.
+    """
+
+    def __init__(self, window: Tuple[jax.Array, ...], roll):
+        self._window = window
+        self._r = (len(window) - 1) // 2
+        self._roll = roll
+
+    def sh(self, dx: int = 0, dy: int = 0, dz: int = 0) -> jax.Array:
+        assert -self._r <= dx <= self._r, (dx, self._r)
+        v = self._window[self._r + dx]
+        if dy:
+            v = self._roll(v, -dy, 0)
+        if dz:
+            v = self._roll(v, -dz, 1)
+        return v
+
+    def center(self) -> jax.Array:
+        return self._window[self._r]
+
+
+@dataclasses.dataclass
+class PlaneInfo:
+    """Traced per-plane context handed to streaming kernels.  ``coords``
+    returns broadcast-compatible pieces — x a scalar (the whole plane shares
+    one global x), y a column, z a row — so kernels written against
+    ``BlockInfo.coords()`` broadcasting run unchanged."""
+
+    x_global: jax.Array  # int32 scalar: wrapped global x of the output plane
+    y_global: jax.Array  # (Y, 1) int32 wrapped global y
+    z_global: jax.Array  # (1, Z) int32 wrapped global z
+    global_size: Dim3
+    level: int  # wavefront level (1-based); 1 on the plane route
+
+    def coords(self):
+        return self.x_global, self.y_global, self.z_global
+
+
+#: a streaming kernel is just a StepKernel evaluated on planes
+PlaneKernel = Callable[[Dict[str, PlaneView], PlaneInfo], Dict[str, jax.Array]]
+
+
+def _yz_coord_planes(origin_ref, Yr, Zr, off_y, off_z, gsize):
+    """Wrapped global y/z coordinates of the raw plane, as a (Yr, 1) column
+    and a (1, Zr) row (2D iotas — Mosaic has no 1D iota)."""
+    y = lax.broadcasted_iota(jnp.int32, (Yr, 1), 0)
+    z = lax.broadcasted_iota(jnp.int32, (1, Zr), 1)
+    gy, gz = jnp.int32(gsize.y), jnp.int32(gsize.z)
+    # + gsize keeps lax.rem's operand non-negative (origin - shell >= -shell)
+    y_g = lax.rem(origin_ref[1] + gy + y - jnp.int32(off_y), gy)
+    z_g = lax.rem(origin_ref[2] + gz + z - jnp.int32(off_z), gz)
+    return y_g, z_g
+
+
+def stream_plane_pass(
+    kernel: PlaneKernel,
+    names: Sequence[str],
+    raws: Sequence[jax.Array],  # per-quantity (X, Y, Z) shell-carrying blocks
+    lo: Dim3,
+    hi: Dim3,  # shell widths (allocation minus interior)
+    x_radius: int,  # kernel x read distance r; ring depth is 2r
+    origin: jax.Array,  # (3,) int32 global coords of the interior start
+    global_size: Dim3,
+    interpret: bool = False,
+) -> List[jax.Array]:
+    """ONE kernel level over shell-carrying blocks, streaming x-planes with a
+    ``2r``-deep ring per quantity; shell planes and the in-plane shell ring
+    pass through unchanged (the exchange owns halo cells).  Generalizes
+    ``mean6_plane_step``/``jacobi_plane_step`` to user kernels, any field
+    count, and any ``r >= 1``."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nq = len(names)
+    X, Y, Z = raws[0].shape
+    r = x_radius
+    assert r >= 1 and lo.x >= r and hi.x >= r, (r, lo, hi)
+    assert lo.y >= r and hi.y >= r and lo.z >= r and hi.z >= r, (r, lo, hi)
+    y0, y1 = lo.y, Y - hi.y
+    z0, z1 = lo.z, Z - hi.z
+    roll = _make_roll(interpret)
+    gsize = global_size
+
+    def body(origin_ref, *refs):
+        in_refs = refs[:nq]
+        out_refs = refs[nq : 2 * nq]
+        rings = refs[2 * nq :]
+        i = pl.program_id(0)
+        curs = [ref[0] for ref in in_refs]
+
+        y_g, z_g = _yz_coord_planes(origin_ref, Y, Z, lo.y, lo.z, gsize)
+
+        # output plane j = i - r; window is raw planes j-r .. j+r
+        j = i - r
+        in_window = jnp.logical_and(j >= lo.x, j <= X - hi.x - 1)
+
+        def plane(q, t):  # raw plane i - t for quantity q (t in [0, 2r])
+            return curs[q] if t == 0 else rings[q][(i - t) % (2 * r)]
+
+        @pl.when(jnp.logical_and(i >= 1, i <= X + r - 1))
+        def _():
+            @pl.when(in_window)
+            def _():
+                views = {
+                    names[q]: PlaneView(
+                        tuple(plane(q, 2 * r - d) for d in range(2 * r + 1)),
+                        roll,
+                    )
+                    for q in range(nq)
+                }
+                x_g = lax.rem(
+                    origin_ref[0] + jnp.int32(gsize.x) + j - jnp.int32(lo.x),
+                    jnp.int32(gsize.x),
+                )
+                info = PlaneInfo(x_g, y_g, z_g, gsize, 1)
+                vals = kernel(views, info)
+                for q, name in enumerate(names):
+                    cent = plane(q, r)
+                    out_refs[q][0] = cent  # keep the y/z shell ring
+                    if name in vals:
+                        out_refs[q][0, y0:y1, z0:z1] = vals[name][
+                            y0:y1, z0:z1
+                        ].astype(cent.dtype)
+
+            @pl.when(jnp.logical_not(in_window))
+            def _():
+                for q in range(nq):
+                    # shell plane j = i - r passes through from the ring
+                    # (slot is garbage for i < r, where plane j < 0 doesn't
+                    # exist — those writes land on out plane 0, which step
+                    # i == r rewrites with the real pass-through)
+                    out_refs[q][0] = plane(q, r)
+
+        @pl.when(i == 0)
+        def _():
+            for q in range(nq):
+                out_refs[q][0] = curs[q]  # first plane passes through
+
+        # push the fetched plane (skip replayed last-plane refetches)
+        @pl.when(i <= X - 1)
+        def _():
+            for q in range(nq):
+                rings[q][i % (2 * r)] = curs[q]
+
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + [
+        pl.BlockSpec((1, Y, Z), lambda i: (jnp.minimum(i, X - 1), 0, 0))
+        for _ in range(nq)
+    ]
+    out_specs = tuple(
+        pl.BlockSpec((1, Y, Z), lambda i: (jnp.clip(i - r, 0, X - 1), 0, 0))
+        for _ in range(nq)
+    )
+    out_shape = tuple(
+        jax.ShapeDtypeStruct((X, Y, Z), b.dtype) for b in raws
+    )
+    outs = pl.pallas_call(
+        body,
+        grid=(X + r,),
+        in_specs=in_specs,
+        out_specs=out_specs if nq > 1 else out_specs[0],
+        out_shape=out_shape if nq > 1 else out_shape[0],
+        scratch_shapes=[
+            pltpu.VMEM((2 * r, Y, Z), b.dtype) for b in raws
+        ],
+        interpret=interpret,
+        **_tpu_compiler_params(interpret),
+    )(origin.astype(jnp.int32), *raws)
+    return list(outs) if nq > 1 else [outs]
+
+
+def stream_wavefront_pass(
+    kernel: PlaneKernel,
+    names: Sequence[str],
+    raws: Sequence[jax.Array],  # per-quantity (Xr, Yr, Zr) FILLED-shell blocks
+    m: int,  # levels to advance (<= shell width)
+    s_off: int,  # shell width (raw index of the interior start)
+    origin: jax.Array,
+    global_size: Dim3,
+    z_slabs: Sequence[jax.Array] = None,  # per-q (Xr, 2s, Yr) z-major slabs
+    z_valid: int = None,  # logical plane width; [z_valid, Zr) is lane padding
+    alias: bool = False,
+    interpret: bool = False,
+):
+    """``m`` kernel levels in ONE pass over ``s_off``-shell-carrying shards —
+    the user-kernel generalization of ``jacobi_shell_wavefront_step`` (see
+    its docstring for the shrinking-validity contamination argument, the
+    z-slab layout, and the lane-padding rationale; all carry over verbatim).
+    Returns the advanced blocks, plus per-quantity outgoing z slabs when
+    ``z_slabs`` is given."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nq = len(names)
+    Xr, Yr, Zr = raws[0].shape
+    zv = Zr if z_valid is None else z_valid
+    assert 1 <= m <= s_off and 2 * s_off < min(Xr, Yr, zv), (m, s_off, zv)
+    gsize = global_size
+    assert 2 * s_off < gsize.x, (s_off, gsize)  # non-negative lax.rem operand
+    roll = _make_roll(interpret)
+
+    def body(origin_ref, *refs):
+        in_refs = refs[:nq]
+        if z_slabs is not None:
+            zs_refs = refs[nq : 2 * nq]
+            out_refs = refs[2 * nq : 3 * nq]
+            zout_refs = refs[3 * nq : 4 * nq]
+            rings = refs[4 * nq :]
+        else:
+            out_refs = refs[nq : 2 * nq]
+            zout_refs = None
+            rings = refs[2 * nq :]
+        i = pl.program_id(0)
+        vals = [ref[0] for ref in in_refs]  # level-0 raw plane i per quantity
+        y_g, z_g = _yz_coord_planes(origin_ref, Yr, Zr, s_off, s_off, gsize)
+        if z_slabs is not None:
+            # patch the z-shell columns in VMEM — never stored in the big
+            # array (see jacobi_shell_wavefront_step)
+            col = lax.broadcasted_iota(jnp.int32, (Yr, Zr), 1)
+            for q in range(nq):
+                zst = jnp.swapaxes(zs_refs[q][0], 0, 1)  # (Yr, 2s)
+                v = vals[q]
+                for j in range(s_off):
+                    v = jnp.where(col == j, zst[:, j][:, None], v)
+                    v = jnp.where(
+                        col == zv - s_off + j, zst[:, s_off + j][:, None], v
+                    )
+                vals[q] = v
+        for s in range(1, m + 1):
+            prevs = [rings[q][s - 1, i % 2] for q in range(nq)]
+            cents = [rings[q][s - 1, (i + 1) % 2] for q in range(nq)]
+            for q in range(nq):
+                rings[q][s - 1, i % 2] = vals[q]  # push plane i-s+1
+            views = {
+                names[q]: PlaneView((prevs[q], cents[q], vals[q]), roll)
+                for q in range(nq)
+            }
+            x_g = lax.rem(
+                origin_ref[0] + jnp.int32(gsize.x) + i - jnp.int32(s + s_off),
+                jnp.int32(gsize.x),
+            )
+            info = PlaneInfo(x_g, y_g, z_g, gsize, s)
+            new = kernel(views, info)
+            vals = [
+                new[names[q]].astype(cents[q].dtype)
+                if names[q] in new
+                else cents[q]
+                for q in range(nq)
+            ]
+        for q in range(nq):
+            out_refs[q][0] = vals[q]  # level-m plane i-m
+            if zout_refs is not None:
+                emit = jnp.concatenate(
+                    [
+                        vals[q][:, zv - 2 * s_off : zv - s_off],
+                        vals[q][:, s_off : 2 * s_off],
+                    ],
+                    axis=1,
+                )  # (Yr, 2s)
+                zout_refs[q][0] = jnp.swapaxes(emit, 0, 1)
+
+    out_idx = lambda i: (jnp.maximum(i - m, 0), 0, 0)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + [
+        pl.BlockSpec((1, Yr, Zr), lambda i: (i, 0, 0)) for _ in range(nq)
+    ]
+    out_specs: list = [pl.BlockSpec((1, Yr, Zr), out_idx) for _ in range(nq)]
+    out_shape: list = [
+        jax.ShapeDtypeStruct((Xr, Yr, Zr), b.dtype) for b in raws
+    ]
+    args = [origin.astype(jnp.int32), *raws]
+    if z_slabs is not None:
+        for q in range(nq):
+            assert z_slabs[q].shape == (Xr, 2 * s_off, Yr), z_slabs[q].shape
+        in_specs += [
+            pl.BlockSpec((1, 2 * s_off, Yr), lambda i: (i, 0, 0))
+            for _ in range(nq)
+        ]
+        out_specs += [pl.BlockSpec((1, 2 * s_off, Yr), out_idx) for _ in range(nq)]
+        out_shape += [
+            jax.ShapeDtypeStruct((Xr, 2 * s_off, Yr), b.dtype) for b in raws
+        ]
+        args += list(z_slabs)
+    # in-place safe (write trails read by m+1 planes); un-aliased is ~20%
+    # faster at deep m (probe21b) at the cost of fresh output buffers
+    aliases = {1 + q: q for q in range(nq)} if alias else {}
+    outs = pl.pallas_call(
+        body,
+        grid=(Xr,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        input_output_aliases=aliases,
+        scratch_shapes=[
+            pltpu.VMEM((m, 2, Yr, Zr), b.dtype) for b in raws
+        ],
+        interpret=interpret,
+        **_tpu_compiler_params(interpret),
+    )(*args)
+    outs = list(outs)
+    if z_slabs is not None:
+        return outs[:nq], outs[nq:]
+    return outs, None
+
+
+def stream_vmem_fits(
+    m: int, plane_y: int, plane_z: int, itemsizes: Sequence[int], z_slabs: bool
+) -> bool:
+    """VMEM model of the generic wavefront: per quantity, 2m ring planes +
+    4 pipeline planes (+ 4 z-slab blocks), plus a PER-QUANTITY stack margin —
+    the level loop holds each field's roll/select temporaries live at once
+    (measured: 8-field m=2 at 518x640 planes reported 108.6 MB against an
+    85 MB block model, ~2.6 MB of stack per field).  Same padded-bytes
+    accounting as ``wavefront_vmem_bytes``."""
+    est = 0
+    for it in itemsizes:
+        est += (2 * m + 4) * _padded_plane_bytes(plane_y, plane_z, it)
+        if z_slabs:
+            est += 4 * _padded_plane_bytes(2 * m, plane_y, it)
+    return est + _VMEM_STACK_MARGIN * len(itemsizes) <= _vmem_budget()
+
+
+def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
+                max_m: int = None) -> dict:
+    """Route planning for ``make_stream_step`` on a REALIZED domain.
+
+    Returns ``{"route": "wavefront"|"plane", "m": int, "z_slabs": bool}``.
+    Wavefront needs: x_radius 1, uniform face shell >= 2, even (unpadded)
+    shards; depth m = the deepest level count that fits the VMEM model,
+    capped by the shell width and the measured plateau (_WRAP_MAX_K).  The
+    plane route covers everything else the engine supports — including
+    PADDED shards: the exchange blends each halo at the dynamic valid-width
+    offset, i.e. adjacent to the valid cells whose stencils read it, and the
+    pad cells beyond compute garbage nothing consumes (the same contract the
+    bespoke per-step routes relied on).
+
+    ``path`` forces a route: "plane" skips the wavefront upgrade (per-step
+    exchange parity, e.g. comm-volume modeling); "wavefront" raises instead
+    of falling back.  Raises ValueError for N-D component data (the engine
+    streams scalar planes only).
+
+    ``separable=True`` declares that the kernel handles arbitrary SUBSETS of
+    the views dict (each field's update reads only that field — astaroth's
+    per-field mean).  When all fields together blow the VMEM model, the plan
+    then falls back to per-field kernel calls ("grouped": one streaming pass
+    per field per macro, same total HBM traffic) instead of a shallower m.
+    ``max_m`` caps the wavefront depth (the runtime compile-failure fallback
+    steps it down).
+    """
+    if any(h.components for h in dd._handles):
+        raise ValueError("the streaming engine does not support N-D component data")
+    if path not in ("auto", "plane", "wavefront"):
+        raise ValueError(f"unknown stream path {path!r}")
+    padded = any(v is not None for v in dd._valid_last)
+    shell = dd._shell_radius
+    lo, hi = shell.lo(), shell.hi()
+    n = dd.local_spec().sz
+    if not all(lo[ax] >= x_radius and hi[ax] >= x_radius for ax in range(3)):
+        raise ValueError(
+            f"shell {lo}/{hi} narrower than the kernel x_radius {x_radius}"
+        )
+    uniform = len({lo.x, lo.y, lo.z, hi.x, hi.y, hi.z}) == 1
+    s = lo.x
+    itemsizes = [h.dtype.itemsize for h in dd._handles]
+    if path != "plane" and x_radius == 1 and uniform and s >= 2 and not padded:
+        cap = min(s, _WRAP_MAX_K, max(1, min(n) // 4))
+        if max_m is not None:
+            cap = min(cap, max_m)
+        raw = dd.local_spec().raw_size()
+        zp = -(-raw.z // 128) * 128
+        # evaluate joint (all fields per pass) AND per-field grouping for
+        # separable kernels, then take the DEEPEST m — depth is the traffic
+        # lever (~8/m B/cell/iter); grouping only changes VMEM pressure and
+        # per-pass ramp overhead, so joint wins ties
+        group_options = [("joint", itemsizes)]
+        if separable and len(itemsizes) > 1:
+            group_options.append(("per-field", [max(itemsizes)]))
+        best = None
+        for grouping, sizes in group_options:
+            for z_mode, plane_z in ((True, zp), (False, raw.z)):
+                m = 0 if z_mode else 1
+                for cand in range(2, cap + 1):
+                    if stream_vmem_fits(cand, raw.y, plane_z, sizes, z_mode):
+                        m = cand
+                if m >= 2 and (best is None or m > best["m"]):
+                    best = {
+                        "route": "wavefront",
+                        "m": m,
+                        "z_slabs": z_mode,
+                        "grouping": grouping,
+                    }
+                if m >= 2:
+                    # take the z-slab form for this grouping even if the
+                    # plain form could fit a level deeper (its slab blocks
+                    # are tiny): the plain form pays the ~64x-amplified
+                    # thin-z in-array exchange every macro (probe12d)
+                    break
+        if best is not None:
+            return best
+    if path == "wavefront":
+        raise ValueError(
+            "path='wavefront' needs x_radius 1, a uniform face shell >= 2, "
+            f"even (unpadded) shards, and VMEM for m >= 2; got shell {lo}/{hi}"
+            + (", padded shards" if padded else "")
+        )
+    raw = dd.local_spec().raw_size()
+    grouping = "joint"
+    if not stream_vmem_fits(x_radius, raw.y, raw.z, itemsizes, False):
+        # (2r+4) resident planes per field blow the budget jointly
+        if separable and len(itemsizes) > 1:
+            grouping = "per-field"
+    return {"route": "plane", "m": 1, "z_slabs": False, "grouping": grouping}
+
+
+def lane_pad_width(z: int) -> int:
+    """Plane width rounded up to a 128 multiple — ragged lane extents stream
+    ~30% slower (probe22), so z-slab wavefronts pad with dead columns."""
+    return -(-z // 128) * 128
+
+
+def prime_z_slabs(block: jax.Array, Zr: int, s: int) -> jax.Array:
+    """The initial outgoing z-slab buffer for a macro chain: the block's
+    interior z-boundary columns, packed [(-z)-bound | (+z)-bound] and
+    transposed z-major (Xr, 2s, Yr) — the one strided read per dispatch;
+    every later slab is kernel-emitted."""
+    return jnp.concatenate(
+        [
+            jnp.swapaxes(block[:, :, Zr - 2 * s : Zr - s], 1, 2),
+            jnp.swapaxes(block[:, :, s : 2 * s], 1, 2),
+        ],
+        axis=1,
+    )
+
+
+def make_slab_extenders(Xr: int, Yr: int, s: int, mesh_shape, axis_names=None):
+    """(yext, xext) for z-major slab buffers: after the z ppermute, each slab
+    is extended with rows from the y neighbors and then planes from the x
+    neighbors — two hops that carry the xyz-corner cells from the diagonal
+    blocks, mirroring the in-array exchange's sweep order.  Shared by the
+    generic engine and the bespoke jacobi wavefront."""
+    from stencil_tpu.ops.exchange import _shift_from_high, _shift_from_low
+    from stencil_tpu.parallel.mesh import MESH_AXES
+
+    names = MESH_AXES if axis_names is None else axis_names
+
+    def yext(S):
+        lo_ = _shift_from_low(S[:, :, Yr - 2 * s : Yr - s], names[1], mesh_shape[1])
+        hi_ = _shift_from_high(S[:, :, s : 2 * s], names[1], mesh_shape[1])
+        return S.at[:, :, 0:s].set(lo_).at[:, :, Yr - s : Yr].set(hi_)
+
+    def xext(S):
+        lo_ = _shift_from_low(S[Xr - 2 * s : Xr - s], names[0], mesh_shape[0])
+        hi_ = _shift_from_high(S[s : 2 * s], names[0], mesh_shape[0])
+        return S.at[0:s].set(lo_).at[Xr - s : Xr].set(hi_)
+
+    return yext, xext
+
+
+def permute_and_extend_z_slabs(zout, s: int, mesh_shape, yext, xext):
+    """One macro's incoming z-slab buffer from the previous macro's outgoing
+    one: ppermute the two direction halves along z, then extend with y- and
+    x-neighbor content (corner propagation)."""
+    from stencil_tpu.ops.exchange import _shift_from_high, _shift_from_low
+    from stencil_tpu.parallel.mesh import MESH_AXES
+
+    zlo = _shift_from_low(zout[:, 0:s, :], MESH_AXES[2], mesh_shape[2])
+    zhi = _shift_from_high(zout[:, s : 2 * s, :], MESH_AXES[2], mesh_shape[2])
+    return jnp.concatenate([xext(yext(zlo)), xext(yext(zhi))], axis=1)
+
+
+def _is_vmem_oom(exc: BaseException) -> bool:
+    msg = str(exc).lower()
+    return "vmem" in msg and ("ran out of memory" in msg or "exceeded" in msg)
+
+
+def _build_stream_step(dd, kernel, x_radius, plan, interpret):
+    from jax.sharding import PartitionSpec as P
+
+    from stencil_tpu.ops.exchange import halo_exchange_multi
+    from stencil_tpu.parallel.mesh import MESH_AXES
+
+    names = [h.name for h in dd._handles]
+    valid_last = dd._valid_last
+    n = dd.local_spec().sz
+    shell = dd._shell_radius
+    lo, hi = shell.lo(), shell.hi()
+    mesh_shape = tuple(dd.mesh.shape[a] for a in MESH_AXES)
+    gsize = dd._size
+    raw = dd.local_spec().raw_size()
+    spec = P(*MESH_AXES)
+    # per-field grouping: one streaming pass per group per macro (valid only
+    # for kernels declared separable); the exchange stays JOINT (<= 6
+    # permutes for any field count) either way
+    if plan.get("grouping") == "per-field":
+        groups = [[q] for q in range(len(names))]
+    else:
+        groups = [list(range(len(names)))]
+    # Un-aliased wavefront passes are ~10-20% faster (probe21b) but cost one
+    # fresh raw-sized buffer per field in flight; with many fields that
+    # doubles a multi-GB working set and can exhaust HBM, so alias (run
+    # in-place) from 4 fields up.  STENCIL_STREAM_ALIAS=0/1 overrides.
+    import os as _os
+
+    _alias_env = _os.environ.get("STENCIL_STREAM_ALIAS", "auto")
+    alias = len(names) >= 4 if _alias_env == "auto" else _alias_env == "1"
+
+    def origin_of():
+        return jnp.stack(
+            [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
+        )
+
+    if plan["route"] == "plane":
+
+        def per_shard(steps, *blocks):
+            origin = origin_of()
+
+            def body(_, bs):
+                bs = list(
+                    halo_exchange_multi(bs, shell, mesh_shape, valid_last=valid_last)
+                )
+                out = list(bs)
+                for g in groups:
+                    outs = stream_plane_pass(
+                        kernel, [names[q] for q in g], [bs[q] for q in g],
+                        lo, hi, x_radius, origin, gsize, interpret=interpret,
+                    )
+                    for q, o in zip(g, outs):
+                        out[q] = o
+                return tuple(out)
+
+            return lax.fori_loop(0, steps, body, tuple(blocks))
+
+    else:
+        m = plan["m"]
+        s = lo.x
+        z_slab_mode = plan["z_slabs"]
+        Xr, Yr, Zr = raw.x, raw.y, raw.z
+        Zp = lane_pad_width(Zr) if z_slab_mode else Zr
+        yext, xext = make_slab_extenders(Xr, Yr, s, mesh_shape)
+
+        def wavefront_groups(bs, depth, origin, zs=None):
+            """Run the m-level pass group by group; returns (outs, zouts)."""
+            outs = list(bs)
+            zouts = [None] * len(bs) if zs is not None else None
+            for g in groups:
+                o, z = stream_wavefront_pass(
+                    kernel, [names[q] for q in g], [bs[q] for q in g],
+                    depth, s, origin, gsize,
+                    z_slabs=[zs[q] for q in g] if zs is not None else None,
+                    z_valid=Zr if zs is not None else None,
+                    alias=alias,
+                    interpret=interpret,
+                )
+                for j, q in enumerate(g):
+                    outs[q] = o[j]
+                    if z is not None:
+                        zouts[q] = z[j]
+            return outs, zouts
+
+        def per_shard(steps, *blocks):
+            origin = origin_of()
+
+            if not z_slab_mode:
+
+                def macro(depth, bs):
+                    bs = list(halo_exchange_multi(bs, shell, mesh_shape))
+                    outs, _ = wavefront_groups(bs, depth, origin)
+                    return tuple(outs)
+
+                macros, rem = divmod(steps, m)
+                bs = lax.fori_loop(0, macros, lambda _, b: macro(m, b), tuple(blocks))
+                if rem:
+                    bs = macro(rem, bs)
+                return bs
+
+            def macro(depth, carry):
+                bs, zouts = carry
+                bs = list(
+                    halo_exchange_multi(bs, shell, mesh_shape, axes=(0, 1))
+                )
+                zs = [
+                    permute_and_extend_z_slabs(zout, s, mesh_shape, yext, xext)
+                    for zout in zouts
+                ]
+                outs, zouts = wavefront_groups(bs, depth, origin, zs)
+                return tuple(outs), tuple(zouts)
+
+            # prime slabs from the blocks' interior z boundaries, lane-pad
+            bs = tuple(
+                jnp.pad(b, ((0, 0), (0, 0), (0, Zp - Zr))) for b in blocks
+            )
+            zouts = tuple(prime_z_slabs(b, Zr, s) for b in blocks)
+            macros, rem = divmod(steps, m)
+            carry = lax.fori_loop(
+                0, macros, lambda _, c: macro(m, c), (bs, zouts)
+            )
+            if rem:
+                carry = macro(rem, carry)
+            return tuple(b[:, :, :Zr] for b in carry[0])
+
+    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def step(curr, steps: int = 1):
+        # check_vma off: pallas_call outputs carry no vma annotation
+        fn = jax.shard_map(
+            partial(per_shard, steps),
+            mesh=dd.mesh,
+            in_specs=tuple(spec for _ in names),
+            out_specs=tuple(spec for _ in names),
+            check_vma=False,
+        )
+        outs = fn(*[curr[k] for k in names])
+        return dict(zip(names, outs))
+
+    return step
+
+
+def make_stream_step(
+    dd,
+    kernel: PlaneKernel,
+    x_radius: int = 1,
+    path: str = "auto",
+    separable: bool = False,
+    interpret: bool = False,
+):
+    """Build a ``step(curr, steps) -> curr`` running ``kernel`` under the
+    plane-streaming engine — the fast-by-default path for user stencils
+    (``DistributedDomain.make_step(..., engine="stream")``).
+
+    The kernel is the SAME ``(views, info) -> {name: values}`` callable the
+    XLA route accepts, restricted to: x shifts within ``x_radius``, in-plane
+    y/z shifts within the shell, elementwise arithmetic (every view read and
+    ``info.coords()`` piece broadcasts to the plane), no N-D component data.
+    ``separable=True`` additionally declares the kernel correct on arbitrary
+    view subsets, letting many-field domains stream per-field (see
+    ``plan_stream``).
+
+    The returned step carries a RUNTIME fallback: if Mosaic rejects the
+    planned wavefront depth (scoped-VMEM OOM — the model under-estimated on
+    this toolchain), the step rebuilds one level shallower and retries,
+    logging a recalibration hint, until the plane route is reached.  The
+    current plan is exposed as ``step._stream_plan``.
+    """
+    plan = plan_stream(dd, x_radius, path, separable)
+    state = {"plan": plan, "impl": _build_stream_step(dd, kernel, x_radius, plan, interpret)}
+
+    def step(curr, steps: int = 1):
+        while True:
+            try:
+                return state["impl"](curr, steps)
+            except Exception as e:  # jax wraps Mosaic failures variously
+                plan_now = state["plan"]
+                if not (_is_vmem_oom(e) and plan_now["route"] == "wavefront"):
+                    raise
+                from stencil_tpu.utils.logging import log_warn
+
+                new_max = plan_now["m"] - 1
+                log_warn(
+                    f"wavefront depth m={plan_now['m']} exceeded the compiler's "
+                    f"scoped-VMEM budget at runtime; stepping down to m<={new_max} "
+                    "(the VMEM model under-estimates on this toolchain — consider "
+                    "recalibrating _VMEM_STACK_MARGIN / STENCIL_VMEM_LIMIT_BYTES)"
+                )
+                state["plan"] = plan_stream(dd, x_radius, path, separable, max_m=new_max)
+                state["impl"] = _build_stream_step(
+                    dd, kernel, x_radius, state["plan"], interpret
+                )
+                step._stream_plan = state["plan"]
+
+    step._marks_shell_stale = True
+    step._stream_plan = plan
+    return step

@@ -13,6 +13,9 @@ def test_astaroth_pallas_matches_jnp(size):
     a.realize()
     b = AstarothSim(*size, num_quantities=2, kernel_impl="pallas", interpret=True)
     b.realize()
+    # the default schedule upgrades even sizes to the temporal wavefront and
+    # falls back to per-step on uneven (padded) sizes
+    assert b._wavefront_m == (3 if size == (28, 28, 28) else 0)
     a.step(3)
     b.step(3)
     for i in range(2):
@@ -29,7 +32,8 @@ def test_astaroth_wavefront_schedule_matches_per_step():
     may perturb by fusing the m levels into one graph (excess-precision /
     reassociation across the division); hence tight-atol, not array_equal
     (a depth-1 macro IS bitwise, see below)."""
-    a = AstarothSim(28, 28, 28, num_quantities=2, kernel_impl="pallas", interpret=True)
+    a = AstarothSim(28, 28, 28, num_quantities=2, kernel_impl="pallas", interpret=True,
+                    schedule="per-step")
     a.realize()
     b = AstarothSim(28, 28, 28, num_quantities=2, kernel_impl="pallas", interpret=True,
                     schedule="wavefront")
@@ -41,8 +45,10 @@ def test_astaroth_wavefront_schedule_matches_per_step():
         np.testing.assert_allclose(a.field(i), b.field(i), rtol=0, atol=1e-6)
 
     # one step = a depth-1 remainder dispatch = the same exchange cadence:
-    # bitwise equal (isolates the cadence question from fusion noise)
-    a1 = AstarothSim(28, 28, 28, kernel_impl="pallas", interpret=True)
+    # near-identical (the engine's plane and wavefront passes evaluate the
+    # same kernel arithmetic; only the shell handling differs)
+    a1 = AstarothSim(28, 28, 28, kernel_impl="pallas", interpret=True,
+                     schedule="per-step")
     a1.realize(); a1.step(1)
     b1 = AstarothSim(28, 28, 28, kernel_impl="pallas", interpret=True,
                      schedule="wavefront")

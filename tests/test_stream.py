@@ -1,0 +1,422 @@
+"""Plane-streaming engine (ops/stream.py): the SAME StepKernel runs under
+make_step(engine="xla") and make_step(engine="stream") with matching results.
+
+This is the user-kernel model of the reference (apps write kernels through
+Accessor, accessor.hpp:13-40; the framework makes them fast) — the engine
+proof is that Jacobi3D/AstarothSim's kernels, VERBATIM, and new user-written
+stencils all agree with the XLA route in interpret mode (1e-6, the ulp slack
+fused-vs-separate XLA graphs carry on CPU), across plane and wavefront
+routes, meshes, and field counts.
+
+Ground truth is always a mult=1 XLA-engine domain stepped once per
+iteration; the stream domain may carry a wider shell (halo multiplier or a
+wide declared radius) that the engine turns into temporal wavefronts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.models.astaroth import AstarothSim
+from stencil_tpu.models.jacobi import Jacobi3D
+
+TOL = dict(rtol=1e-6, atol=1e-6)
+
+
+def _mk(x, y, z, radius, names, devices, mult=1, init=None, dtype=jnp.float32):
+    dd = DistributedDomain(x, y, z)
+    dd.set_radius(radius)
+    dd.set_devices(devices)
+    if mult != 1:
+        dd.set_halo_multiplier(mult)
+    hs = [dd.add_data(n, dtype=dtype) for n in names]
+    dd.realize()
+    for i, h in enumerate(hs):
+        f = init or (lambda x_, y_, z_, i=i: jnp.sin(0.13 * (x_ + 2 * y_ + 3 * z_) + i))
+        dd.init_by_coords(h, f)
+    return dd, hs
+
+
+def _run_both(mk_ref, mk_stream, kernel, steps, x_radius=None):
+    """Run the XLA engine (per-step ground truth) and the stream engine the
+    same number of ITERATIONS; return paired host fields + the stream step."""
+    dd_a, hs_a = mk_ref()
+    dd_b, hs_b = mk_stream()
+    step_a = dd_a.make_step(kernel, overlap=False)
+    step_b = dd_b.make_step(kernel, engine="stream", x_radius=x_radius, interpret=True)
+    assert dd_a.halo_multiplier() == 1  # ground truth advances 1 iter/step
+    dd_a.run_step(step_a, steps)
+    dd_b.run_step(step_b, steps)
+    outs = []
+    for ha, hb in zip(hs_a, hs_b):
+        outs.append((dd_a.quantity_to_host(ha), dd_b.quantity_to_host(hb)))
+    return outs, step_b
+
+
+def mean6_kernel(views, info):
+    out = {}
+    for name, src in views.items():
+        out[name] = (
+            src.sh(-1, 0, 0)
+            + src.sh(0, -1, 0)
+            + src.sh(0, 0, -1)
+            + src.sh(1, 0, 0)
+            + src.sh(0, 1, 0)
+            + src.sh(0, 0, 1)
+        ) / 6.0
+    return out
+
+
+def stencil27_kernel(views, info):
+    """27-point weighted stencil — a NEW user stencil written only against
+    the public kernel API (the engine's 'users are fast by default' proof)."""
+    src = views["u"]
+    acc = 0.0
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            for dz in (-1, 0, 1):
+                w = 1.0 / (2.0 ** (abs(dx) + abs(dy) + abs(dz)))
+                acc = acc + w * src.sh(dx, dy, dz)
+    return {"u": acc / 7.0}
+
+
+def vc_diffusion_kernel(views, info):
+    """Variable-coefficient diffusion: the coefficient is a second FIELD the
+    kernel reads but never updates (pass-through under both engines)."""
+    u, c = views["u"], views["c"]
+    lap = (
+        u.sh(-1, 0, 0) + u.sh(1, 0, 0)
+        + u.sh(0, -1, 0) + u.sh(0, 1, 0)
+        + u.sh(0, 0, -1) + u.sh(0, 0, 1)
+        - 6.0 * u.center()
+    )
+    return {"u": u.center() + c.center() * lap}
+
+
+def forced_kernel(views, info):
+    """Coordinate-dependent forcing — exercises info.coords() broadcasting
+    under both engines (scalar x / column y / row z on the stream route)."""
+    src = views["u"]
+    cx, cy, cz = info.coords()
+    g = info.global_size
+    val = (src.sh(1, 0, 0) + src.sh(-1, 0, 0) + src.sh(0, 1, 0) + src.sh(0, -1, 0)) / 4.0
+    d2 = (cx - g.x // 2) ** 2 + (cy - g.y // 2) ** 2 + (cz - g.z // 2) ** 2
+    return {"u": jnp.where(d2 < 9, 1.0, val).astype(src.center().dtype)}
+
+
+def test_stream_plane_route_single_device():
+    dev = jax.devices()[:1]
+    r1 = Radius.constant(1)
+    outs, step = _run_both(
+        lambda: _mk(12, 10, 11, r1, ["u"], dev),
+        lambda: _mk(12, 10, 11, r1, ["u"], dev),
+        mean6_kernel, 3,
+    )
+    assert step._stream_plan["route"] == "plane"  # shell 1: no wavefront
+    for a, b in outs:
+        np.testing.assert_allclose(a, b, **TOL)
+
+
+def test_stream_plane_route_multi_device_multi_quantity():
+    devs = jax.devices()[:8]
+    r1 = Radius.constant(1)
+    outs, _ = _run_both(
+        lambda: _mk(16, 12, 8, r1, ["u", "v"], devs),
+        lambda: _mk(16, 12, 8, r1, ["u", "v"], devs),
+        mean6_kernel, 3,
+    )
+    for a, b in outs:
+        np.testing.assert_allclose(a, b, **TOL)
+
+
+def test_stream_wavefront_route():
+    devs = jax.devices()[:8]
+    r1 = Radius.constant(1)
+    outs, step = _run_both(
+        lambda: _mk(24, 24, 24, r1, ["u"], devs),
+        lambda: _mk(24, 24, 24, r1, ["u"], devs, mult=3),
+        mean6_kernel,
+        7,  # 2 macros + remainder 1
+    )
+    assert step._stream_plan["route"] == "wavefront"
+    assert step._stream_plan["m"] == 3
+    for a, b in outs:
+        np.testing.assert_allclose(a, b, **TOL)
+
+
+def test_stream_wavefront_wide_radius_narrow_reads():
+    """Astaroth's pattern: radius-3 shell, distance-1 reads — the engine
+    wavefronts m=3 against ONE exchange without a halo multiplier."""
+    devs = jax.devices()[:8]
+    outs, step = _run_both(
+        lambda: _mk(24, 24, 24, Radius.constant(1), ["u"], devs),
+        lambda: _mk(24, 24, 24, Radius.constant(3), ["u"], devs),
+        mean6_kernel,
+        5,
+        x_radius=1,
+    )
+    assert step._stream_plan["route"] == "wavefront"
+    assert step._stream_plan["m"] == 3
+    for a, b in outs:
+        np.testing.assert_allclose(a, b, **TOL)
+
+
+def test_stream_27point_new_user_stencil():
+    devs = jax.devices()[:8]
+    r1 = Radius.constant(1)
+    outs, _ = _run_both(
+        lambda: _mk(16, 16, 16, r1, ["u"], devs),
+        lambda: _mk(16, 16, 16, r1, ["u"], devs),
+        stencil27_kernel, 4,
+    )
+    for a, b in outs:
+        np.testing.assert_allclose(a, b, **TOL)
+
+
+def test_stream_27point_wavefront():
+    devs = jax.devices()[:8]
+    r1 = Radius.constant(1)
+    outs, step = _run_both(
+        lambda: _mk(24, 24, 24, r1, ["u"], devs),
+        lambda: _mk(24, 24, 24, r1, ["u"], devs, mult=2),
+        stencil27_kernel,
+        4,
+    )
+    assert step._stream_plan["route"] == "wavefront"
+    for a, b in outs:
+        np.testing.assert_allclose(a, b, **TOL)
+
+
+def test_stream_vc_diffusion_passthrough_field():
+    devs = jax.devices()[:8]
+
+    def mk():
+        dd = DistributedDomain(16, 12, 12)
+        dd.set_radius(Radius.constant(1))
+        dd.set_devices(devs)
+        hu = dd.add_data("u")
+        hc = dd.add_data("c")
+        dd.realize()
+        dd.init_by_coords(hu, lambda x, y, z: jnp.sin(0.3 * x + 0.2 * y + 0.1 * z))
+        dd.init_by_coords(hc, lambda x, y, z: 0.05 + 0.01 * jnp.cos(0.2 * (x + y - z)))
+        return dd, [hu, hc]
+
+    outs, _ = _run_both(mk, mk, vc_diffusion_kernel, 3)
+    (ua, ub), (ca, cb) = outs
+    np.testing.assert_allclose(ua, ub, **TOL)
+    np.testing.assert_array_equal(ca, cb)  # coefficient untouched by both
+
+
+def test_stream_coords_forcing():
+    devs = jax.devices()[:8]
+    r1 = Radius.constant(1)
+    outs, _ = _run_both(
+        lambda: _mk(16, 16, 16, r1, ["u"], devs),
+        lambda: _mk(16, 16, 16, r1, ["u"], devs),
+        forced_kernel, 4,
+    )
+    for a, b in outs:
+        np.testing.assert_allclose(a, b, **TOL)
+
+
+def test_stream_coords_forcing_wavefront():
+    """Forcing through shell levels: coords() must be periodic-wrapped so
+    intermediate-level shell cells force correctly (they feed valid cells)."""
+    devs = jax.devices()[:8]
+    r1 = Radius.constant(1)
+    outs, _ = _run_both(
+        lambda: _mk(24, 24, 24, r1, ["u"], devs),
+        lambda: _mk(24, 24, 24, r1, ["u"], devs, mult=3),
+        forced_kernel,
+        6,
+    )
+    for a, b in outs:
+        np.testing.assert_allclose(a, b, **TOL)
+
+
+def _jacobi_radius():
+    r = Radius.constant(0)
+    r.set_face(1)
+    return r
+
+
+def test_stream_jacobi_model_kernel_verbatim():
+    """Jacobi3D's OWN kernel under the stream engine equals the XLA route and
+    the model's bespoke pallas wavefront path — nothing is lost."""
+    devs = jax.devices()[:8]
+    n = 24
+
+    model = Jacobi3D(n, n, n, devices=devs)
+    model.realize()
+
+    mid = lambda x, y, z: jnp.full((), 0.5) + 0 * (x + y + z)
+    dd, hs = _mk(n, n, n, _jacobi_radius(), ["temp"], devs, mult=3, init=mid)
+    step = dd.make_step(model._kernel, engine="stream", interpret=True)
+    assert step._stream_plan["route"] == "wavefront"
+    model.step(5)
+    dd.run_step(step, 5)
+    np.testing.assert_allclose(
+        model.temperature(), dd.quantity_to_host(hs[0]), **TOL
+    )
+
+    wf = Jacobi3D(n, n, n, devices=devs, kernel_impl="pallas",
+                  pallas_path="wavefront", temporal_k=3, interpret=True)
+    wf.realize()
+    wf.step(5)
+    np.testing.assert_allclose(model.temperature(), wf.temperature(), **TOL)
+
+
+def test_stream_astaroth_model_kernel_verbatim():
+    devs = jax.devices()[:8]
+    n = 24
+    a = AstarothSim(n, n, n, num_quantities=2, devices=devs)
+    a.realize()
+    b = AstarothSim(n, n, n, num_quantities=2, devices=devs)
+    b.realize()
+    step = b.dd.make_step(b._kernel, engine="stream", x_radius=1, interpret=True)
+    assert step._stream_plan["route"] == "wavefront"
+    a.step(5)
+    b.dd.run_step(step, 5)
+    for i in range(2):
+        np.testing.assert_allclose(
+            a.field(i), b.dd.quantity_to_host(b.handles[i]), **TOL
+        )
+
+
+def test_stream_padded_plane_route():
+    """Padded (uneven) shards run on the plane route: the exchange blends
+    halos at the dynamic valid-width offsets, so the streamed kernel reads
+    correct neighbors and pad cells compute garbage nothing consumes."""
+    devs = jax.devices()[:8]
+    r1 = Radius.constant(1)
+    outs, step = _run_both(
+        lambda: _mk(15, 13, 15, r1, ["u"], devs),
+        lambda: _mk(15, 13, 15, r1, ["u"], devs),
+        mean6_kernel, 3,
+    )
+    assert step._stream_plan["route"] == "plane"
+    for a, b in outs:
+        np.testing.assert_allclose(a, b, **TOL)
+
+
+def test_stream_separable_per_field_grouping(monkeypatch):
+    """When many fields jointly blow the VMEM model, a separable kernel
+    streams per-field at FULL wavefront depth instead of a shallower m."""
+    import stencil_tpu.ops.stream as sm
+
+    devs = jax.devices()[:8]
+    r3 = Radius.constant(3)
+    names = ["a", "b", "c", "d"]
+    # 5 MB budget: four 24x128-padded-plane rings don't fit jointly at m>=2
+    # (12.5 MB modeled) but a single field does (3.1 MB)
+    monkeypatch.setenv("STENCIL_VMEM_LIMIT_BYTES", "5000000")
+    dd, hs = _mk(24, 24, 24, r3, names, devs)
+    step = dd.make_step(
+        mean6_kernel, engine="stream", x_radius=1, separable=True, interpret=True
+    )
+    assert step._stream_plan == {
+        "route": "wavefront", "m": 3, "z_slabs": True, "grouping": "per-field",
+    }
+    monkeypatch.delenv("STENCIL_VMEM_LIMIT_BYTES")
+    ref_dd, ref_hs = _mk(24, 24, 24, Radius.constant(1), names, devs)
+    ref = ref_dd.make_step(mean6_kernel, overlap=False)
+    dd.run_step(step, 5)
+    ref_dd.run_step(ref, 5)
+    for ha, hb in zip(ref_hs, hs):
+        np.testing.assert_allclose(
+            ref_dd.quantity_to_host(ha), dd.quantity_to_host(hb), **TOL
+        )
+
+
+def test_stream_runtime_vmem_fallback(monkeypatch):
+    """A Mosaic scoped-VMEM OOM at the planned depth steps the wavefront
+    down one level and retries instead of crashing (the VMEM model is
+    toolchain-calibrated; a compiler upgrade may shift it)."""
+    import stencil_tpu.ops.stream as sm
+
+    real_build = sm._build_stream_step
+    calls = {"n": 0}
+
+    def fake_build(dd, kernel, r, plan, interp):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            assert plan["m"] == 3
+
+            def boom(curr, steps=1):
+                raise RuntimeError(
+                    "Ran out of memory in memory space vmem ... "
+                    "exceeded scoped vmem limit by 8.59M"
+                )
+
+            return boom
+        return real_build(dd, kernel, r, plan, interp)
+
+    monkeypatch.setattr(sm, "_build_stream_step", fake_build)
+    devs = jax.devices()[:8]
+    dd, hs = _mk(24, 24, 24, Radius.constant(1), ["u"], devs, mult=3)
+    step = dd.make_step(mean6_kernel, engine="stream", interpret=True)
+    assert step._stream_plan["m"] == 3
+    dd.run_step(step, 4)  # first call: fake OOM -> rebuild at m=2 -> runs
+    assert step._stream_plan["m"] == 2
+    assert calls["n"] == 2
+
+    ref_dd, ref_hs = _mk(24, 24, 24, Radius.constant(1), ["u"], devs)
+    ref = ref_dd.make_step(mean6_kernel, overlap=False)
+    ref_dd.run_step(ref, 4)
+    np.testing.assert_allclose(
+        ref_dd.quantity_to_host(ref_hs[0]), dd.quantity_to_host(hs[0]), **TOL
+    )
+
+
+def test_stream_tiny_budget_degrades_to_plane(monkeypatch):
+    """An over-tight env budget degrades the plan to the plane route (and a
+    joint 4-field plane pass to per-field) — never a crash."""
+    monkeypatch.setenv("STENCIL_VMEM_LIMIT_BYTES", "100000")
+    devs = jax.devices()[:8]
+    dd, hs = _mk(24, 24, 24, Radius.constant(1), ["a", "b"], devs, mult=3)
+    step = dd.make_step(
+        mean6_kernel, engine="stream", separable=True, interpret=True
+    )
+    assert step._stream_plan["route"] == "plane"
+    assert step._stream_plan["grouping"] == "per-field"
+
+
+def test_stream_forced_paths_and_rejects():
+    devs = jax.devices()[:8]
+    dd = DistributedDomain(15, 15, 15)  # pads over a [2,2,2] mesh
+    dd.set_radius(Radius.constant(1))
+    dd.set_devices(devs)
+    dd.add_data("u")
+    dd.set_halo_multiplier(2)
+    dd.realize()
+    if any(v is not None for v in dd._valid_last):
+        # padded: wavefront must refuse, plane is the fallback
+        with pytest.raises(ValueError):
+            dd.make_step(
+                mean6_kernel, engine="stream", stream_path="wavefront",
+                interpret=True,
+            )
+
+    # stream_path="plane" forces per-step exchange despite a wide shell
+    dd1 = DistributedDomain(16, 16, 16)
+    dd1.set_radius(Radius.constant(1))
+    dd1.set_devices(devs)
+    dd1.add_data("u")
+    dd1.set_halo_multiplier(2)
+    dd1.realize()
+    dd1.init_by_coords(dd1._handles[0], lambda x, y, z: jnp.sin(0.2 * (x + y + z)))
+    step = dd1.make_step(mean6_kernel, engine="stream", stream_path="plane",
+                         interpret=True)
+    assert step._stream_plan["route"] == "plane"
+
+    # N-D component data stays on the XLA engine
+    dd2 = DistributedDomain(16, 16, 16)
+    dd2.set_radius(Radius.constant(1))
+    dd2.set_devices(devs)
+    dd2.add_data("v", components=(3,))
+    dd2.realize()
+    with pytest.raises(ValueError):
+        dd2.make_step(mean6_kernel, engine="stream", interpret=True)

@@ -626,13 +626,22 @@ class DistributedDomain:
         )
         return per_dom * self.num_subdomains()
 
-    def write_plan(self, prefix: str = "plan") -> str:
+    def write_plan(self, prefix: str = "plan", link_model=None) -> str:
         """Dump the communication plan — the analog of the reference's
         per-rank ``plan_<rank>.txt`` (src/stencil.cu:259-353): the placement
         report plus one line per direction with the message extent and bytes
-        (all riding the collective exchange).  Returns the path written."""
+        (all riding the collective exchange), then the projected ICI/DCN
+        exchange cost (``parallel/cost.py`` — measured defaults, or a
+        ``LinkModel`` built from this framework's pingpong/bench-alltoallv
+        output).  Returns the path written."""
         from stencil_tpu.core.direction_map import DIRECTIONS_26
         from stencil_tpu.core.geometry import exchange_bytes
+        from stencil_tpu.parallel.cost import (
+            LinkModel,
+            axis_edge_kinds,
+            format_cost_report,
+            projected_exchange_cost,
+        )
 
         lines = [self.placement.report(), "", "# messages (method=ppermute for all)"]
         spec = self._spec
@@ -645,6 +654,11 @@ class DistributedDomain:
             lines.append(f"dir={d} extent={ext} bytes={nbytes} method=ppermute")
         total = exchange_bytes(spec, itemsizes)
         lines.append(f"# total bytes per exchange per subdomain: {total}")
+        link = link_model or LinkModel()
+        rows, total_ms = projected_exchange_cost(
+            spec, itemsizes, axis_edge_kinds(self.mesh), link
+        )
+        lines += format_cost_report(rows, total_ms, link, self._halo_mult)
         path = f"{prefix}_{jax.process_index()}.txt"
         with open(path, "w") as f:
             f.write("\n".join(lines) + "\n")
@@ -712,7 +726,7 @@ class DistributedDomain:
                 )
             return make_stream_step(
                 self, kernel, x_radius=x_radius, path=stream_path,
-                separable=separable, interpret=interpret,
+                separable=separable, interpret=interpret, donate=donate,
             )
         if engine != "xla":
             raise ValueError(f"unknown engine {engine!r}")

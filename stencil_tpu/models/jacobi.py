@@ -213,6 +213,12 @@ class Jacobi3D:
 
         dd = self.dd
         m = self._wavefront_m
+        # effective depth <= the allocated shell width m: the VMEM-OOM
+        # fallback steps it down WITHOUT reallocating (the kernel supports
+        # depth < shell via interior_offset; the exchange keeps the full
+        # m-wide shell, just refreshed every `depth` steps)
+        depth_run = getattr(self, "_wavefront_depth", m)
+        assert 1 <= depth_run <= m, (depth_run, m)
         n = dd.local_spec().sz
         shell = dd._shell_radius
         mesh_shape = tuple(dd.mesh.shape[a] for a in MESH_AXES)
@@ -262,8 +268,10 @@ class Jacobi3D:
                         alias=alias, interpret=interpret,
                     )
 
-                macros, rem = divmod(steps, m)
-                b = lax.fori_loop(0, macros, lambda _, b: macro_plain(m, b), raw_block)
+                macros, rem = divmod(steps, depth_run)
+                b = lax.fori_loop(
+                    0, macros, lambda _, b: macro_plain(depth_run, b), raw_block
+                )
                 if rem:
                     b = macro_plain(rem, b)
                 return b
@@ -289,8 +297,8 @@ class Jacobi3D:
                 jnp.pad(raw_block, ((0, 0), (0, 0), (0, Zp - Zr))),
                 prime_z_slabs(raw_block, Zr, m),
             )
-            macros, rem = divmod(steps, m)
-            carry = lax.fori_loop(0, macros, lambda _, c: macro(m, c), carry)
+            macros, rem = divmod(steps, depth_run)
+            carry = lax.fori_loop(0, macros, lambda _, c: macro(depth_run, c), carry)
             if rem:
                 carry = macro(rem, carry)
             return carry[0][:, :, :Zr]
@@ -556,9 +564,51 @@ class Jacobi3D:
         return {"temp": val.astype(src.center().dtype)}
 
     def step(self, steps: int = 1) -> None:
-        self.dd.run_step(self._step, steps)
+        while True:
+            try:
+                self.dd.run_step(self._step, steps)
+                break
+            except Exception as e:
+                if not self._step_down_on_vmem_oom(e):
+                    raise
         if self._marks_shell_stale:
             self.dd.mark_shell_stale()
+
+    def _step_down_on_vmem_oom(self, e: BaseException) -> bool:
+        """Runtime fallback for the bespoke pallas paths: when Mosaic
+        rejects the planned temporal depth (scoped-VMEM OOM — the calibrated
+        model under-estimated on this toolchain), rebuild one level
+        shallower instead of crashing.  The wavefront keeps its allocated
+        m-wide shell and just advances fewer levels per pass
+        (``_wavefront_depth``); the wrap path re-plans with ``temporal_k-1``.
+        Returns True when a shallower rebuild was installed."""
+        from stencil_tpu.ops.stream import _is_vmem_oom
+        from stencil_tpu.utils.logging import log_warn
+
+        if not _is_vmem_oom(e) or self.kernel_impl != "pallas":
+            return False
+        if self._pallas_path == "wrap" and self._wrap_k > 1:
+            self.temporal_k = self._wrap_k - 1
+            log_warn(
+                f"wrap temporal depth k={self._wrap_k} exceeded the compiler's "
+                f"scoped-VMEM budget; retrying k={self.temporal_k} (recalibrate "
+                "the VMEM model / STENCIL_VMEM_LIMIT_BYTES for this toolchain)"
+            )
+            self._step = self._make_pallas_step()
+            return True
+        if self._pallas_path == "wavefront":
+            depth = getattr(self, "_wavefront_depth", self._wavefront_m)
+            if depth <= 1:
+                return False
+            self._wavefront_depth = depth - 1
+            log_warn(
+                f"wavefront depth {depth} exceeded the compiler's scoped-VMEM "
+                f"budget; retrying depth {depth - 1} over the same {self._wavefront_m}"
+                "-wide shell (recalibrate the VMEM model for this toolchain)"
+            )
+            self._step = self._make_wavefront_step()
+            return True
+        return False
 
     def temperature(self) -> np.ndarray:
         return self.dd.quantity_to_host(self.h)

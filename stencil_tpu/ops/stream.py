@@ -67,7 +67,13 @@ class PlaneView:
         self._roll = roll
 
     def sh(self, dx: int = 0, dy: int = 0, dz: int = 0) -> jax.Array:
-        assert -self._r <= dx <= self._r, (dx, self._r)
+        # ALL axes are bounded by the declared read radius: an in-plane
+        # shift beyond it would wrap opposite-edge values into cells the
+        # validity contract counts as correct — silently wrong results, so
+        # fail at trace time instead
+        assert all(-self._r <= d <= self._r for d in (dx, dy, dz)), (
+            (dx, dy, dz), self._r,
+        )
         v = self._window[self._r + dx]
         if dy:
             v = self._roll(v, -dy, 0)
@@ -531,7 +537,7 @@ def _is_vmem_oom(exc: BaseException) -> bool:
     return "vmem" in msg and ("ran out of memory" in msg or "exceeded" in msg)
 
 
-def _build_stream_step(dd, kernel, x_radius, plan, interpret):
+def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
     from jax.sharding import PartitionSpec as P
 
     from stencil_tpu.ops.exchange import halo_exchange_multi
@@ -656,7 +662,9 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret):
                 carry = macro(rem, carry)
             return tuple(b[:, :, :Zr] for b in carry[0])
 
-    @partial(jax.jit, static_argnums=1, donate_argnums=0)
+    donate_kw = {"donate_argnums": 0} if donate else {}
+
+    @partial(jax.jit, static_argnums=1, **donate_kw)
     def step(curr, steps: int = 1):
         # check_vma off: pallas_call outputs carry no vma annotation
         fn = jax.shard_map(
@@ -679,15 +687,17 @@ def make_stream_step(
     path: str = "auto",
     separable: bool = False,
     interpret: bool = False,
+    donate: bool = True,
 ):
     """Build a ``step(curr, steps) -> curr`` running ``kernel`` under the
     plane-streaming engine — the fast-by-default path for user stencils
     (``DistributedDomain.make_step(..., engine="stream")``).
 
     The kernel is the SAME ``(views, info) -> {name: values}`` callable the
-    XLA route accepts, restricted to: x shifts within ``x_radius``, in-plane
-    y/z shifts within the shell, elementwise arithmetic (every view read and
-    ``info.coords()`` piece broadcasts to the plane), no N-D component data.
+    XLA route accepts, restricted to: ALL shifts (x, y, and z) within
+    ``x_radius`` (``PlaneView.sh`` asserts this at trace time), elementwise
+    arithmetic (every view read and ``info.coords()`` piece broadcasts to
+    the plane), no N-D component data.
     ``separable=True`` additionally declares the kernel correct on arbitrary
     view subsets, letting many-field domains stream per-field (see
     ``plan_stream``).
@@ -699,7 +709,10 @@ def make_stream_step(
     current plan is exposed as ``step._stream_plan``.
     """
     plan = plan_stream(dd, x_radius, path, separable)
-    state = {"plan": plan, "impl": _build_stream_step(dd, kernel, x_radius, plan, interpret)}
+    state = {
+        "plan": plan,
+        "impl": _build_stream_step(dd, kernel, x_radius, plan, interpret, donate),
+    }
 
     def step(curr, steps: int = 1):
         while True:
@@ -720,7 +733,7 @@ def make_stream_step(
                 )
                 state["plan"] = plan_stream(dd, x_radius, path, separable, max_m=new_max)
                 state["impl"] = _build_stream_step(
-                    dd, kernel, x_radius, state["plan"], interpret
+                    dd, kernel, x_radius, state["plan"], interpret, donate
                 )
                 step._stream_plan = state["plan"]
 

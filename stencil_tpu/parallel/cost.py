@@ -1,0 +1,131 @@
+"""ICI/DCN exchange cost model — quantify multi-chip viability on paper.
+
+One real chip is all this environment ever sees, so the wavefront macro's
+cross-chip critical path cannot be *measured* here; this model puts a number
+on it instead: per-axis sweep bytes (``core/geometry.sweep_bytes`` pieces) /
+measured-or-default edge bandwidth + a per-collective latency, classified
+ICI vs DCN by whether the mesh neighbors along the axis live in different
+processes.  ``DistributedDomain.write_plan`` appends the projection so every
+plan dump (the reference's ``plan_<rank>.txt``, src/stencil.cu:259-353 +
+``exchange_bytes_for_method``) carries projected ms/exchange per direction.
+
+Defaults are v5e datasheet-class figures; refine them with THIS framework's
+own measurements: ``LinkModel.from_pingpong`` ingests a pingpong round trip
+(bin/pingpong.py), and bench-alltoallv's contended matrix traversals bound
+the congestion factor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+#: v5e class defaults: ~45 GB/s usable per ICI link direction (4x 400 Gbps
+#: links, counting one link per mesh-axis direction), ~6 GB/s per host NIC
+#: for DCN hops, ~25 us per collective dispatch.  Deliberately conservative;
+#: measurements override.
+ICI_DEFAULT_GBPS = 45.0
+DCN_DEFAULT_GBPS = 6.0
+LATENCY_DEFAULT_US = 25.0
+
+
+@dataclasses.dataclass
+class LinkModel:
+    ici_gbps: float = ICI_DEFAULT_GBPS
+    dcn_gbps: float = DCN_DEFAULT_GBPS
+    latency_us: float = LATENCY_DEFAULT_US
+
+    @classmethod
+    def from_pingpong(cls, nbytes: int, round_trip_s: float, **kw) -> "LinkModel":
+        """Edge bandwidth from one pingpong row (bin/pingpong.py): a round
+        trip moves ``nbytes`` each way, so bw = 2*nbytes/time.  Extra kwargs
+        override the other fields."""
+        gbps = 2.0 * nbytes / max(round_trip_s, 1e-12) / 1e9
+        return cls(ici_gbps=gbps, **kw)
+
+    def gbps(self, kind: str) -> float:
+        return self.ici_gbps if kind == "ici" else self.dcn_gbps
+
+
+def axis_edge_kinds(mesh) -> List[str]:
+    """Classify each mesh axis's neighbor edge: "ici" when the +1 neighbor
+    (wrapped) of the origin device lives in the same process, "dcn"
+    otherwise, "self" for unsharded axes (self-permute, no wire)."""
+    import numpy as np
+
+    devs = np.asarray(mesh.devices)
+    kinds = []
+    for ax in range(devs.ndim):
+        if devs.shape[ax] == 1:
+            kinds.append("self")
+            continue
+        a = devs[(0,) * devs.ndim]
+        idx = [0] * devs.ndim
+        idx[ax] = 1
+        b = devs[tuple(idx)]
+        pa = getattr(a, "process_index", 0)
+        pb = getattr(b, "process_index", 0)
+        kinds.append("ici" if pa == pb else "dcn")
+    return kinds
+
+
+def projected_exchange_cost(
+    spec,
+    itemsizes: Sequence[int],
+    kinds: Sequence[str],
+    link: LinkModel = None,
+) -> Tuple[List[Tuple[str, int, str, float]], float]:
+    """Project one 3-axis-sweep exchange on the given edge kinds.
+
+    Returns ``(rows, total_ms)`` where each row is
+    ``(axis_dir_label, bytes, edge_kind, ms)`` for the six sweep messages
+    (each axis's slab spans the full raw extent of the other axes — the
+    ``sweep_bytes`` accounting, core/geometry.py:200).  The lo/hi pair of an
+    axis rides the same links in opposite directions (full duplex), so the
+    axis cost is max(lo, hi) + latency; axes serialize (the sweep order is a
+    data dependency: later axes carry earlier axes' halos).  A "self" edge
+    (unsharded axis) costs one HBM-side copy, modeled at ICI speed — cheap
+    and never the critical path.
+    """
+    link = link or LinkModel()
+    raw = spec.raw_size()
+    r = spec.radius
+    itemsize_sum = sum(int(s) for s in itemsizes)
+    rows: List[Tuple[str, int, str, float]] = []
+    total_ms = 0.0
+    for ax, name in enumerate("xyz"):
+        widths = (r.axis(ax, -1), r.axis(ax, +1))
+        if widths == (0, 0):
+            continue
+        others = [raw[b] for b in range(3) if b != ax]
+        plane = others[0] * others[1]
+        kind = kinds[ax]
+        gbps = link.gbps("ici" if kind == "self" else kind)
+        pair_ms = []
+        for w, dlabel in zip(widths, ("-", "+")):
+            nbytes = itemsize_sum * plane * w
+            ms = nbytes / (gbps * 1e9) * 1e3
+            rows.append((f"{dlabel}{name}", nbytes, kind, ms))
+            pair_ms.append(ms)
+        total_ms += max(pair_ms) + link.latency_us / 1e3
+    return rows, total_ms
+
+
+def format_cost_report(rows, total_ms, link: LinkModel, halo_mult: int = 1) -> List[str]:
+    """Plan-dump lines for ``write_plan``."""
+    lines = [
+        "",
+        "# projected exchange cost (ICI/DCN model, parallel/cost.py: "
+        f"ici={link.ici_gbps:.1f} GB/s dcn={link.dcn_gbps:.1f} GB/s "
+        f"latency={link.latency_us:.0f} us; lo/hi full duplex, axes serialize)",
+    ]
+    for label, nbytes, kind, ms in rows:
+        lines.append(f"dir={label} bytes={nbytes} edge={kind} projected_ms={ms:.4f}")
+    lines.append(f"# projected ms per exchange: {total_ms:.4f}")
+    if halo_mult > 1:
+        lines.append(
+            f"# projected ms per MACRO step (halo multiplier {halo_mult}: one "
+            f"exchange per {halo_mult} iterations): {total_ms:.4f} "
+            f"({total_ms / halo_mult:.4f} amortized per iteration)"
+        )
+    return lines

@@ -1,0 +1,72 @@
+"""ICI/DCN exchange cost model (parallel/cost.py): pinned arithmetic and the
+write_plan integration."""
+
+import jax
+import pytest
+
+from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.core.geometry import LocalSpec
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.parallel.cost import (
+    LinkModel,
+    axis_edge_kinds,
+    projected_exchange_cost,
+)
+
+
+def _spec(sz, r):
+    radius = Radius.constant(r)
+    return LocalSpec(Dim3(*sz), Dim3(0, 0, 0), radius)
+
+
+def test_projected_cost_arithmetic():
+    # 64^3 interior, radius 2 -> raw 68^3; one f32 quantity
+    spec = _spec((64, 64, 64), 2)
+    link = LinkModel(ici_gbps=10.0, dcn_gbps=1.0, latency_us=100.0)
+    rows, total_ms = projected_exchange_cost(
+        spec, [4], ["ici", "ici", "dcn"], link
+    )
+    # each axis: slab = 68*68 plane * width 2 * 4 B = 36,992 B each way
+    nbytes = 68 * 68 * 2 * 4
+    assert [r[1] for r in rows] == [nbytes] * 6
+    assert [r[2] for r in rows] == ["ici", "ici", "ici", "ici", "dcn", "dcn"]
+    # per-axis cost: max(lo, hi)/bw + latency; axes serialize
+    ms_ici = nbytes / 10e9 * 1e3
+    ms_dcn = nbytes / 1e9 * 1e3
+    expect = (ms_ici + 0.1) + (ms_ici + 0.1) + (ms_dcn + 0.1)
+    assert total_ms == pytest.approx(expect, rel=1e-12)
+    assert rows[0][3] == pytest.approx(ms_ici, rel=1e-12)
+    assert rows[4][3] == pytest.approx(ms_dcn, rel=1e-12)
+
+
+def test_projected_cost_uneven_radius_and_zero_axis():
+    r = Radius.constant(0)
+    r.set_dir(Dim3(1, 0, 0), 2)  # +x only
+    spec = LocalSpec(Dim3(32, 32, 32), Dim3(0, 0, 0), r)
+    rows, total_ms = projected_exchange_cost(spec, [4], ["ici"] * 3, LinkModel())
+    # only the x axis contributes; -x width 0 -> zero-byte row, +x width 2
+    assert len(rows) == 2
+    raw = spec.raw_size()
+    assert rows[0] == ("-x", 0, "ici", 0.0)
+    assert rows[1][1] == raw.y * raw.z * 2 * 4
+
+
+def test_from_pingpong():
+    lm = LinkModel.from_pingpong(1_000_000, 0.0001)  # 1 MB each way in 100 us
+    assert lm.ici_gbps == pytest.approx(20.0)
+
+
+def test_axis_edge_kinds_and_write_plan(tmp_path):
+    from stencil_tpu.domain import DistributedDomain
+
+    dd = DistributedDomain(16, 16, 16)
+    dd.set_radius(Radius.constant(1))
+    dd.set_devices(jax.devices()[:8])
+    dd.add_data("u")
+    dd.realize()
+    kinds = axis_edge_kinds(dd.mesh)
+    assert all(k in ("ici", "dcn", "self") for k in kinds)
+    path = dd.write_plan(prefix=str(tmp_path / "plan"))
+    text = open(path).read()
+    assert "projected ms per exchange:" in text
+    assert "edge=" in text and "projected_ms=" in text

@@ -340,7 +340,7 @@ def test_stream_runtime_vmem_fallback(monkeypatch):
     real_build = sm._build_stream_step
     calls = {"n": 0}
 
-    def fake_build(dd, kernel, r, plan, interp):
+    def fake_build(dd, kernel, r, plan, interp, donate=True):
         calls["n"] += 1
         if calls["n"] == 1:
             assert plan["m"] == 3
@@ -352,7 +352,7 @@ def test_stream_runtime_vmem_fallback(monkeypatch):
                 )
 
             return boom
-        return real_build(dd, kernel, r, plan, interp)
+        return real_build(dd, kernel, r, plan, interp, donate)
 
     monkeypatch.setattr(sm, "_build_stream_step", fake_build)
     devs = jax.devices()[:8]
@@ -369,6 +369,47 @@ def test_stream_runtime_vmem_fallback(monkeypatch):
     np.testing.assert_allclose(
         ref_dd.quantity_to_host(ref_hs[0]), dd.quantity_to_host(hs[0]), **TOL
     )
+
+
+def test_jacobi_bespoke_vmem_fallback():
+    """The bespoke jacobi paths step down on a runtime scoped-VMEM OOM too:
+    wrap re-plans at k-1; the wavefront keeps its allocated m-wide shell and
+    advances fewer levels per pass."""
+    dev = jax.devices()[:1]
+
+    boom = RuntimeError("Ran out of memory in memory space vmem ... exceeded")
+
+    def raise_once(model):
+        real = model._step
+        state = {"fired": False}
+
+        def wrapped(curr, steps=1):
+            if not state["fired"]:
+                state["fired"] = True
+                raise boom
+            return real(curr, steps)
+
+        model._step = wrapped
+
+    m = Jacobi3D(24, 24, 24, devices=dev, kernel_impl="pallas", temporal_k=4,
+                 interpret=True)
+    m.realize()
+    raise_once(m)
+    m.step(8)
+    assert m._wrap_k == 3
+    ref = Jacobi3D(24, 24, 24, devices=dev, kernel_impl="pallas", temporal_k=1,
+                   interpret=True)
+    ref.realize()
+    ref.step(8)
+    np.testing.assert_array_equal(ref.temperature(), m.temperature())
+
+    w = Jacobi3D(24, 24, 24, devices=dev, kernel_impl="pallas",
+                 pallas_path="wavefront", temporal_k=4, interpret=True)
+    w.realize()
+    raise_once(w)
+    w.step(8)
+    assert w._wavefront_depth == 3 and w._wavefront_m == 4
+    np.testing.assert_allclose(ref.temperature(), w.temperature(), **TOL)
 
 
 def test_stream_tiny_budget_degrades_to_plane(monkeypatch):

@@ -114,12 +114,19 @@ class Jacobi3D:
 
     def _plan_wavefront(self) -> int:
         """Choose the wavefront depth m (>= 1) before ``dd.realize()``: mirror
-        the domain's deterministic mesh/shard computation, require even
-        (unpadded) shards, and fit ``temporal_k`` ("auto") within the shard
-        extents and the modeled VMEM limit.  Prefers the z-slab kernel
-        variant (z halos never touch the tiled array) and records the choice
-        in ``self._wavefront_z_planned``; when even depth 2 with slabs does
-        not fit, falls back to the plain variant at its own deepest m."""
+        the domain's deterministic mesh/shard computation and fit
+        ``temporal_k`` ("auto") within the shard extents and the modeled VMEM
+        limit.  Prefers the z-slab kernel variant (z halos never touch the
+        tiled array) and records the choice in ``self._wavefront_z_planned``.
+
+        PADDED (uneven) shards are supported on the PLAIN kernel variant:
+        the valid-width exchange places each halo contiguously after the
+        valid cells, so the wavefront's shrinking-validity and
+        wrapped-coordinate arguments hold unchanged at the dynamic positions
+        (see ``ops/stream.plan_stream``); the z-slab form's static interior
+        emit slices keep it even-shard-only, and the depth is capped by the
+        smallest VALID extent (partition.hpp:83-114 parity: remainders run
+        at full speed)."""
         import jax
 
         from stencil_tpu.ops.jacobi_pallas import (
@@ -138,12 +145,16 @@ class Jacobi3D:
         )
         dim = placement.dim()
         n = [-(-dd._size[ax] // dim[ax]) for ax in range(3)]
-        if any(dd._size[ax] != n[ax] * dim[ax] for ax in range(3)):
+        padded = any(dd._size[ax] != n[ax] * dim[ax] for ax in range(3))
+        # last-shard valid extents; min caps the depth (a shard must fill an
+        # m-wide halo for its neighbor from valid cells)
+        v = [dd._size[ax] - n[ax] * (dim[ax] - 1) for ax in range(3)]
+        if min(v) < 1:
             raise ValueError(
-                "pallas_path='wavefront' requires even (unpadded) sizes; "
-                f"{tuple(dd._size)} over mesh {tuple(dim)} pads"
+                f"pallas_path='wavefront': empty last shard for {tuple(dd._size)} "
+                f"over mesh {tuple(dim)}"
             )
-        n_min = min(n)
+        n_min = min(min(n), min(v))
         itemsize = self.h.dtype.itemsize
 
         def fits(m, z):
@@ -154,15 +165,17 @@ class Jacobi3D:
         if self.temporal_k != "auto":
             m = int(self.temporal_k)
             if not 1 <= m <= n_min:
-                raise ValueError(f"wavefront temporal_k={m} needs 1 <= m <= min(shard)={n_min}")
+                raise ValueError(
+                    f"wavefront temporal_k={m} needs 1 <= m <= min(shard/valid)={n_min}"
+                )
             warn_if_over_vmem_budget(m, n[1] + 2 * m, n[2] + 2 * m, itemsize)
-            self._wavefront_z_planned = fits(m, True)
+            self._wavefront_z_planned = fits(m, True) and not padded
             return m
         # n_min//4 caps the redundant shell traffic: a depth-m macro step
         # exchanges ~6*m*n^2 extra cells against m*n^3 of compute, so keep
         # the shell a small fraction of the shard
-        depth_cap = min(_WRAP_MAX_K, max(1, n_min // 4))
-        for z_mode in (True, False):
+        depth_cap = min(_WRAP_MAX_K, max(1, n_min // 4), n_min)
+        for z_mode in ((True, False) if not padded else (False,)):
             m = 1 if not z_mode else 0
             for cand in range(2, depth_cap + 1):
                 if fits(cand, z_mode):
@@ -262,7 +275,9 @@ class Jacobi3D:
 
             if not z_slab_mode:
                 def macro_plain(depth, b):
-                    b = halo_exchange_shard(b, shell, mesh_shape)
+                    b = halo_exchange_shard(
+                        b, shell, mesh_shape, valid_last=dd._valid_last
+                    )
                     return jacobi_shell_wavefront_step(
                         b, depth, origin, yz_d2, gsize, interior_offset=m,
                         alias=alias, interpret=interpret,

@@ -389,14 +389,24 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
     """Route planning for ``make_stream_step`` on a REALIZED domain.
 
     Returns ``{"route": "wavefront"|"plane", "m": int, "z_slabs": bool}``.
-    Wavefront needs: x_radius 1, uniform face shell >= 2, even (unpadded)
-    shards; depth m = the deepest level count that fits the VMEM model,
-    capped by the shell width and the measured plateau (_WRAP_MAX_K).  The
-    plane route covers everything else the engine supports — including
-    PADDED shards: the exchange blends each halo at the dynamic valid-width
-    offset, i.e. adjacent to the valid cells whose stencils read it, and the
-    pad cells beyond compute garbage nothing consumes (the same contract the
-    bespoke per-step routes relied on).
+    Wavefront needs: x_radius 1, uniform face shell >= 2; depth m = the
+    deepest level count that fits the VMEM model, capped by the shell width
+    and the measured plateau (_WRAP_MAX_K).  The plane route covers
+    everything else the engine supports.
+
+    PADDED (uneven) shards run BOTH routes: the exchange blends each halo at
+    the dynamic valid-width offset, i.e. contiguously after the valid cells,
+    so (a) every valid cell's stencil reads the right neighbor, (b) the
+    wrapped linear coordinate formula ``(origin - s + index) mod g`` is
+    correct at the halo positions too (the global size equals the last
+    shard's origin + valid width), and (c) pad cells beyond the halo
+    contaminate only the sacrificial shrinking-validity levels — the same
+    argument as the wavefront's dead lane padding.  Hence the PLAIN
+    wavefront works on padded shards with no kernel changes; only the
+    z-slab form (static emit slices at the interior z boundary) stays
+    even-shard-only, and the depth is additionally capped by the smallest
+    VALID extent (a shard narrower than the shell cannot fill its
+    neighbor's halo).
 
     ``path`` forces a route: "plane" skips the wavefront upgrade (per-step
     exchange parity, e.g. comm-volume modeling); "wavefront" raises instead
@@ -426,8 +436,19 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
     uniform = len({lo.x, lo.y, lo.z, hi.x, hi.y, hi.z}) == 1
     s = lo.x
     itemsizes = [h.dtype.itemsize for h in dd._handles]
-    if path != "plane" and x_radius == 1 and uniform and s >= 2 and not padded:
-        cap = min(s, _WRAP_MAX_K, max(1, min(n) // 4))
+    if path != "plane" and x_radius == 1 and uniform and s >= 2:
+        # (No shell-traffic heuristic here: the shell width s is GIVEN — the
+        # domain already allocated and exchanges it — so advancing more
+        # levels per exchange is strictly less traffic.)  realize() already
+        # rejects any shard whose valid extent is below the shell width
+        # (domain.py "subdomain ... smaller than radius shell"), so every
+        # shard this plan can see fills an s-wide halo from valid cells.
+        v_min = min(
+            (dd._valid_last[ax] if dd._valid_last[ax] is not None else n[ax])
+            for ax in range(3)
+        )
+        assert v_min >= s, (v_min, s)  # the realize() invariant
+        cap = min(s, _WRAP_MAX_K)
         if max_m is not None:
             cap = min(cap, max_m)
         raw = dd.local_spec().raw_size()
@@ -440,8 +461,10 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
         if separable and len(itemsizes) > 1:
             group_options.append(("per-field", [max(itemsizes)]))
         best = None
+        # z-slab form's static emit slices assume even shards
+        z_modes = ((False, raw.z),) if padded else ((True, zp), (False, raw.z))
         for grouping, sizes in group_options:
-            for z_mode, plane_z in ((True, zp), (False, raw.z)):
+            for z_mode, plane_z in z_modes:
                 m = 0 if z_mode else 1
                 for cand in range(2, cap + 1):
                     if stream_vmem_fits(cand, raw.y, plane_z, sizes, z_mode):
@@ -464,8 +487,8 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
     if path == "wavefront":
         raise ValueError(
             "path='wavefront' needs x_radius 1, a uniform face shell >= 2, "
-            f"even (unpadded) shards, and VMEM for m >= 2; got shell {lo}/{hi}"
-            + (", padded shards" if padded else "")
+            "valid shard extents >= the depth, and VMEM for m >= 2; got "
+            f"shell {lo}/{hi}"
         )
     raw = dd.local_spec().raw_size()
     grouping = "joint"
@@ -627,7 +650,11 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
             if not z_slab_mode:
 
                 def macro(depth, bs):
-                    bs = list(halo_exchange_multi(bs, shell, mesh_shape))
+                    bs = list(
+                        halo_exchange_multi(
+                            bs, shell, mesh_shape, valid_last=valid_last
+                        )
+                    )
                     outs, _ = wavefront_groups(bs, depth, origin)
                     return tuple(outs)
 

@@ -48,24 +48,33 @@ class LinkModel:
 
 
 def axis_edge_kinds(mesh) -> List[str]:
-    """Classify each mesh axis's neighbor edge: "ici" when the +1 neighbor
-    (wrapped) of the origin device lives in the same process, "dcn"
-    otherwise, "self" for unsharded axes (self-permute, no wire)."""
+    """Classify each mesh axis's neighbor edges: "self" for unsharded axes
+    (self-permute, no wire), "dcn" if ANY adjacent pair along the axis —
+    including the periodic wrap edge — crosses a process boundary (the
+    collective's critical hop rides the slowest link), "ici" otherwise.
+    A node-major axis mixing intra- and inter-host hops is therefore
+    priced at DCN speed."""
     import numpy as np
 
     devs = np.asarray(mesh.devices)
     kinds = []
     for ax in range(devs.ndim):
-        if devs.shape[ax] == 1:
+        size = devs.shape[ax]
+        if size == 1:
             kinds.append("self")
             continue
-        a = devs[(0,) * devs.ndim]
-        idx = [0] * devs.ndim
-        idx[ax] = 1
-        b = devs[tuple(idx)]
-        pa = getattr(a, "process_index", 0)
-        pb = getattr(b, "process_index", 0)
-        kinds.append("ici" if pa == pb else "dcn")
+        lead = [0] * devs.ndim
+        kind = "ici"
+        for j in range(size):
+            a_idx, b_idx = list(lead), list(lead)
+            a_idx[ax] = j
+            b_idx[ax] = (j + 1) % size
+            pa = getattr(devs[tuple(a_idx)], "process_index", 0)
+            pb = getattr(devs[tuple(b_idx)], "process_index", 0)
+            if pa != pb:
+                kind = "dcn"
+                break
+        kinds.append(kind)
     return kinds
 
 

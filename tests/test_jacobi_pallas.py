@@ -126,11 +126,15 @@ def test_slab_forced_rejects_unaligned_x_on_tpu(monkeypatch):
         m.realize()
 
 
-def test_wavefront_rejects_uneven():
-    with pytest.raises(ValueError, match="even"):
-        m = Jacobi3D(15, 16, 16, kernel_impl="pallas", interpret=True,
-                     pallas_path="wavefront")
-        m.realize()
+def test_wavefront_accepts_uneven_on_plain_variant():
+    """Padded sizes run the wavefront's PLAIN kernel variant (full-speed
+    uneven support, partition.hpp:83-114 parity); see test_uneven.py for the
+    gold numerics."""
+    m = Jacobi3D(15, 16, 16, kernel_impl="pallas", interpret=True,
+                 pallas_path="wavefront")
+    m.realize()
+    assert m._pallas_path == "wavefront"
+    assert not m._wavefront_z_slabs
 
 
 def test_bf16_wrap_and_wavefront_paths():

@@ -13,9 +13,9 @@ def test_astaroth_pallas_matches_jnp(size):
     a.realize()
     b = AstarothSim(*size, num_quantities=2, kernel_impl="pallas", interpret=True)
     b.realize()
-    # the default schedule upgrades even sizes to the temporal wavefront and
-    # falls back to per-step on uneven (padded) sizes
-    assert b._wavefront_m == (3 if size == (28, 28, 28) else 0)
+    # the default schedule upgrades to the temporal wavefront everywhere:
+    # even sizes on the z-slab variant, padded sizes on the plain variant
+    assert b._wavefront_m == 3
     a.step(3)
     b.step(3)
     for i in range(2):
@@ -56,10 +56,12 @@ def test_astaroth_wavefront_schedule_matches_per_step():
     np.testing.assert_array_equal(a1.field(0), b1.field(0))
 
 
-def test_astaroth_wavefront_rejects_uneven_and_jnp():
+def test_astaroth_wavefront_uneven_and_jnp_guard():
+    # uneven sizes run the wavefront's PLAIN variant at full depth now
     m = AstarothSim(15, 14, 13, kernel_impl="pallas", interpret=True,
                     schedule="wavefront")
-    with pytest.raises(ValueError, match="even"):
-        m.realize()
+    m.realize()
+    assert m._wavefront_m == 3
+    # the temporal schedule needs the streaming engine
     with pytest.raises(ValueError, match="pallas"):
         AstarothSim(16, 16, 16, schedule="wavefront").realize()

@@ -75,10 +75,16 @@ def test_model_routes_slab_multidevice():
     assert m._pallas_path == "slab"
 
 
-def test_model_routes_shell_when_uneven():
+def test_model_routes_wavefront_plain_when_uneven():
+    # uneven sizes now reach the temporal fast path too (plain kernel
+    # variant; the z-slab form needs even shards) — full-speed uneven,
+    # partition.hpp:83-114 parity
     m = Jacobi3D(17, 18, 19, kernel_impl="pallas", interpret=True)
     m.realize()
-    assert m._pallas_path == "shell"
+    assert m._pallas_path == "wavefront"
+    assert not m._wavefront_z_slabs
+
+
 
 
 @pytest.mark.parametrize("size", [(24, 24, 24), (16, 24, 32)])

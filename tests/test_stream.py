@@ -434,12 +434,14 @@ def test_stream_forced_paths_and_rejects():
     dd.set_halo_multiplier(2)
     dd.realize()
     if any(v is not None for v in dd._valid_last):
-        # padded: wavefront must refuse, plane is the fallback
-        with pytest.raises(ValueError):
-            dd.make_step(
-                mean6_kernel, engine="stream", stream_path="wavefront",
-                interpret=True,
-            )
+        # padded: wavefront runs on the PLAIN kernel variant (the z-slab
+        # form's static emit slices need even shards)
+        step = dd.make_step(
+            mean6_kernel, engine="stream", stream_path="wavefront",
+            interpret=True,
+        )
+        assert step._stream_plan["route"] == "wavefront"
+        assert not step._stream_plan["z_slabs"]
 
     # stream_path="plane" forces per-step exchange despite a wide shell
     dd1 = DistributedDomain(16, 16, 16)

@@ -1,0 +1,31 @@
+"""Probe25: z-ring (interior-only HBM z) vs padded z-slab wavefront, 512^3."""
+import os, time
+import jax, jax.numpy as jnp
+from stencil_tpu.bin._common import host_round_trip_s
+from stencil_tpu.models.jacobi import Jacobi3D
+
+def run(m_depth, ring, rt, n=512):
+    os.environ["STENCIL_Z_RING"] = "1" if ring else "0"
+    model = Jacobi3D(n, n, n, devices=jax.devices()[:1], kernel_impl="pallas",
+                     pallas_path="wavefront", temporal_k=m_depth)
+    model.realize()
+    assert model._wavefront_z_ring == ring
+    steps = 96 // m_depth * m_depth
+    model.step(steps)
+    float(jnp.sum(model.dd.get_curr(model.h)[0,0,0:1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        model.step(steps)
+        float(jnp.sum(model.dd.get_curr(model.h)[0,0,0:1]))
+        best = min(best, (time.perf_counter() - t0 - rt) / steps)
+    print(f"m={m_depth} ring={ring}: {n**3/best/1e6:,.0f} Mcells/s", flush=True)
+
+def main():
+    rt = host_round_trip_s()
+    for m in (8, 16):
+        for ring in (False, True):
+            run(m, ring, rt)
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Probe25b: z-ring wavefront depth sweep, interleaved repeats."""
+import os, time
+import jax, jax.numpy as jnp
+from stencil_tpu.bin._common import host_round_trip_s
+from stencil_tpu.models.jacobi import Jacobi3D
+
+def main():
+    rt = host_round_trip_s()
+    n = 512
+    os.environ["STENCIL_Z_RING"] = "1"
+    models = {}
+    for m in (6, 8, 10, 12, 16):
+        model = Jacobi3D(n, n, n, devices=jax.devices()[:1], kernel_impl="pallas",
+                         pallas_path="wavefront", temporal_k=m)
+        model.realize()
+        assert model._wavefront_z_ring
+        steps = 96 // m * m
+        model.step(steps)
+        float(jnp.sum(model.dd.get_curr(model.h)[0,0,0:1]))
+        models[m] = (model, steps)
+    best = {m: float("inf") for m in models}
+    for rep in range(3):
+        for m, (model, steps) in models.items():
+            t0 = time.perf_counter()
+            model.step(steps)
+            float(jnp.sum(model.dd.get_curr(model.h)[0,0,0:1]))
+            best[m] = min(best[m], (time.perf_counter() - t0 - rt) / steps)
+            print(f"rep{rep} m={m}: {n**3/((time.perf_counter()-t0-rt)/steps)/1e6:,.0f}", flush=True)
+    print({m: f"{n**3/v/1e6:,.0f}" for m, v in best.items()})
+
+if __name__ == "__main__":
+    main()

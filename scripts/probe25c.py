@@ -1,0 +1,31 @@
+"""Probe25c: z-ring depths, one model at a time, two rounds."""
+import os, time
+import jax, jax.numpy as jnp
+from stencil_tpu.bin._common import host_round_trip_s
+from stencil_tpu.models.jacobi import Jacobi3D
+
+def one(m, rt, n=512):
+    model = Jacobi3D(n, n, n, devices=jax.devices()[:1], kernel_impl="pallas",
+                     pallas_path="wavefront", temporal_k=m)
+    model.realize()
+    steps = 96 // m * m
+    model.step(steps)
+    float(jnp.sum(model.dd.get_curr(model.h)[0,0,0:1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        model.step(steps)
+        float(jnp.sum(model.dd.get_curr(model.h)[0,0,0:1]))
+        best = min(best, (time.perf_counter() - t0 - rt) / steps)
+    print(f"m={m}: {n**3/best/1e6:,.0f} Mcells/s", flush=True)
+    del model
+
+def main():
+    os.environ["STENCIL_Z_RING"] = "1"
+    rt = host_round_trip_s()
+    for rnd in range(2):
+        for m in (8, 12, 16):
+            one(m, rt)
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Probe25d: tight A/B of ring vs padded z-slab wavefront at m=16, alternating
+timed runs on co-resident models so contention hits both equally."""
+import os, time
+import jax, jax.numpy as jnp
+from stencil_tpu.bin._common import host_round_trip_s
+from stencil_tpu.models.jacobi import Jacobi3D
+
+def build(ring, m=8, n=512):
+    os.environ["STENCIL_Z_RING"] = "1" if ring else "0"
+    model = Jacobi3D(n, n, n, devices=jax.devices()[:1], kernel_impl="pallas",
+                     pallas_path="wavefront", temporal_k=m)
+    model.realize()
+    assert model._wavefront_z_ring == ring
+    steps = 96
+    model.step(steps)
+    float(jnp.sum(model.dd.get_curr(model.h)[0,0,0:1]))
+    return model, steps
+
+def main():
+    rt = host_round_trip_s()
+    n = 512
+    pad_m, steps = build(False)
+    ring_m, _ = build(True)
+    best = {"pad": float("inf"), "ring": float("inf")}
+    for rep in range(5):
+        for label, model in (("pad", pad_m), ("ring", ring_m)):
+            t0 = time.perf_counter()
+            model.step(steps)
+            float(jnp.sum(model.dd.get_curr(model.h)[0,0,0:1]))
+            dt = (time.perf_counter() - t0 - rt) / steps
+            best[label] = min(best[label], dt)
+            print(f"rep{rep} {label}: {n**3/dt/1e6:,.0f}", flush=True)
+    print({k: f"{n**3/v/1e6:,.0f}" for k, v in best.items()})
+
+if __name__ == "__main__":
+    main()

@@ -212,9 +212,12 @@ class Jacobi3D:
 
         from stencil_tpu.ops.exchange import halo_exchange_shard
         from stencil_tpu.ops.jacobi_pallas import (
+            _ZRING_OFF,
             jacobi_shell_wavefront_step,
+            jacobi_zring_wavefront_step,
             pack_d2,
             yz_dist2_plane,
+            zring_dist2_plane,
         )
         from stencil_tpu.ops.stream import (
             lane_pad_width,
@@ -256,6 +259,19 @@ class Jacobi3D:
         self._pallas_path = "wavefront"
         self._wavefront_z_slabs = z_slab_mode
         Xr, Yr, Zr = raw.x, raw.y, raw.z
+        # z-RING layout: when the shard's z interior is lane-aligned, drop
+        # the z-shell columns from HBM entirely — the kernel stages each
+        # plane into a ring-layout working plane whose lane wrap is
+        # periodic-consistent (jacobi_zring_wavefront_step) — cutting the
+        # streamed bytes by the whole z pad share (~20% at 512^3 m=16,
+        # probe24/25).  STENCIL_Z_RING=0 restores the padded layout.
+        z_ring_mode = (
+            z_slab_mode
+            and n.z % 128 == 0
+            and 2 * m <= _ZRING_OFF
+            and os.environ.get("STENCIL_Z_RING", "1") != "0"
+        )
+        self._wavefront_z_ring = z_ring_mode
         # Ragged lane extents cripple the plane DMA (probe22: 512^2x516
         # streams 30% slower than 512^3; 512^2x640 runs at full per-byte
         # rate), so the z-slab route rounds the plane width up to a 128
@@ -294,6 +310,39 @@ class Jacobi3D:
             # slab y/x extension (corner propagation) + z permute + priming
             # are shared with the generic engine (ops/stream.py helpers)
             yext, xext = make_slab_extenders(Xr, Yr, m, mesh_shape)
+
+            if z_ring_mode:
+                # z-interior-only HBM layout + ring-layout working planes
+                Zi = n.z
+                ring_d2 = pack_d2(
+                    zring_dist2_plane(origin[1] - m, origin[2], m, Yr, Zi, gsize),
+                    gsize,
+                )
+
+                def macro_ring(depth, carry):
+                    b, zout = carry
+                    b = halo_exchange_shard(b, shell, mesh_shape, axes=(0, 1))
+                    zs = permute_and_extend_z_slabs(zout, m, mesh_shape, yext, xext)
+                    return jacobi_zring_wavefront_step(
+                        b, depth, origin, ring_d2, gsize, z_slabs=zs,
+                        interior_offset=m, alias=alias, interpret=interpret,
+                    )
+
+                b0 = lax.slice(
+                    raw_block, (0, 0, m), (Xr, Yr, m + Zi)
+                )  # drop the z-shell columns from the streamed array
+                carry = (b0, prime_z_slabs(raw_block, Zr, m))
+                macros, rem = divmod(steps, depth_run)
+                carry = lax.fori_loop(
+                    0, macros, lambda _, c: macro_ring(depth_run, c), carry
+                )
+                if rem:
+                    carry = macro_ring(rem, carry)
+                # re-inflate with zero z-shell columns instead of writing
+                # back into raw_block: equivalent (the shell is stale either
+                # way) and lets raw_block's buffer die at the b0 slice
+                # instead of living across the whole macro loop
+                return jnp.pad(carry[0], ((0, 0), (0, 0), (m, m)))
 
             def macro(depth, carry):
                 b, zout = carry
@@ -349,6 +398,13 @@ class Jacobi3D:
           writes, no halo re-read; the traffic of the wrap kernel plus the 6
           messages.  The TPU expression of the reference's production
           overlapped multi-GPU pipeline (jacobi3d.cu:265-337).
+          SUPERSEDED as a default by the temporally-blocked ``wavefront``
+          (m levels per exchange vs this route's 1); kept for explicit
+          request and as the m=1 structural baseline.  Its Mosaic
+          z-column-rotate constraint (128-aligned shard x-extent) makes it
+          unreachable for most real mesh shapes — by design we did not lift
+          it, since the wavefront route both outperforms it and has no such
+          constraint.
         * ``shell`` — fallback (uneven/padded sizes, or shards with < 2
           x-planes): the general shell-carrying exchange + plane kernel.
         """

@@ -455,6 +455,149 @@ def jacobi_shell_wavefront_step(
     )(*args)
 
 
+#: lane offset of the interior segment in the z-ring working plane; the lo
+#: halo sits immediately below it, the hi halo wraps to lane 0 (see
+#: jacobi_zring_wavefront_step) — must stay a multiple of 128 so the
+#: staging/output slices are lane-aligned, and >= 2*s_off
+_ZRING_OFF = 128
+
+
+def zring_dist2_plane(origin_y, origin_z, s_off: int, shape_y: int, z_interior: int, global_size):
+    """``yz_dist2_plane`` for the z-RING working layout: lanes [0, s_off)
+    hold the hi halo (z = Zi..Zi+s_off), lanes [_ZRING_OFF - s_off,
+    _ZRING_OFF) the lo halo, lanes [_ZRING_OFF, _ZRING_OFF + Zi) the
+    interior — the linear formula covers interior+lo contiguously and one
+    select fixes the wrapped hi segment (dead lanes get harmless wrapped
+    values)."""
+    gy, gz = global_size[1], global_size[2]
+    W = _ZRING_OFF + z_interior
+    y = (origin_y + jnp.arange(shape_y)) % gy
+    c = jnp.arange(W)
+    z_lin = origin_z + c - _ZRING_OFF
+    z_hi = origin_z + z_interior + c
+    z = jnp.where(c < s_off, z_hi, z_lin) % gz
+    cy, cz = gy // 2, gz // 2
+    return ((y - cy) ** 2)[:, None] + ((z - cz) ** 2)[None, :]
+
+
+def jacobi_zring_wavefront_step(
+    raw: jax.Array,  # (Xr, Yr, Zi): x/y FILLED shell carried in-array, z
+    # INTERIOR-ONLY (the 20%-of-DMA z-shell/lane-pad columns are gone from
+    # HBM entirely); Zi % 128 == 0
+    m: int,  # levels to advance (<= the shell width)
+    origin: jax.Array,  # (3,) int32 global coords of the shard's interior start
+    d2: jax.Array,  # (Yr, Zi + 128) int32 from zring_dist2_plane
+    global_size: Tuple[int, int, int],
+    z_slabs: jax.Array,  # (Xr, 2s, Yr) z-major: rows [0, s) = my lo halo,
+    # [s, 2s) = my hi halo (same convention as jacobi_shell_wavefront_step)
+    interior_offset: int = None,
+    alias: bool = False,
+    interpret: bool = False,
+):
+    """``m`` Jacobi levels per pass with the z halo in a RING-layout VMEM
+    working plane — the deep-wavefront path that streams NO z padding.
+
+    probe24: at 512^3 m=16 the macro is ~82% kernel pass, and the pass costs
+    exactly the wrap kernel x the padded-array ratio (544^2 x 640 / 512^3 =
+    1.41).  The z share of that ratio is pure waste: in z-slab mode the
+    in-array z-shell columns are never read (the kernel patches halos from
+    the slab buffers), yet they force either ragged-lane DMA (~30% slower,
+    probe22) or 640-wide lane padding.  Here HBM stores only the Zi
+    interior columns; each streamed (Yr, Zi) plane is staged into a
+    (Yr, Zi + 128) working plane at lane offset 128 whose LANE WRAP is
+    periodic-consistent by construction:
+
+        lanes [0, s)            hi halo  (z = Zi .. Zi+s)
+        lanes [s, 128 - s)      dead
+        lanes [128 - s, 128)    lo halo  (z = -s .. 0)
+        lanes [128, 128 + Zi)   interior (z = c - 128)
+
+    ``roll(plane, -1)`` brings lane 0 (hi halo z=Zi) to lane 127+Zi
+    (interior z=Zi-1) — its true +z neighbor; ``roll(plane, +1)`` brings
+    lane 127 (lo halo z=-1) to lane 128 (interior z=0).  Both seams are
+    neighbor-correct, the hi/lo outermost halo lanes border dead lanes and
+    are valid only at level 0 — exactly the shrinking-validity contract —
+    and every staging/output slice sits at a 128-aligned lane offset.
+    Returns ``(out, z_out)`` with the same z_out convention as the
+    shell-layout kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Xr, Yr, Zi = raw.shape
+    s_off = m if interior_offset is None else interior_offset
+    OFF = _ZRING_OFF
+    W = OFF + Zi
+    assert Zi % 128 == 0 and 2 * s_off <= OFF, (Zi, s_off)
+    assert 1 <= m <= s_off and 2 * s_off < min(Xr, Yr), (m, s_off, raw.shape)
+    gx = global_size[0]
+    assert 2 * s_off < gx, (s_off, gx)
+    assert d2.shape == (Yr, W) and jnp.issubdtype(d2.dtype, jnp.integer), d2.shape
+    assert z_slabs.shape == (Xr, 2 * s_off, Yr), (z_slabs.shape, raw.shape)
+    hot_x, cold_x, in_r2 = sphere_params(gx)
+    roll = _make_roll(interpret)
+
+    def kernel(origin_ref, in_ref, d2_ref, zs_ref, out_ref, zout_ref, ring):
+        i = pl.program_id(0)
+        d2v = d2_ref[...]
+        # stage the interior plane at lane offset OFF and patch the halo
+        # segments from the slab block (one small transpose per plane)
+        vals = jnp.pad(in_ref[0], ((0, 0), (OFF, 0)))
+        zst = jnp.swapaxes(zs_ref[0], 0, 1)  # (Yr, 2s)
+        col = jax.lax.broadcasted_iota(jnp.int32, (Yr, W), 1)
+        for j in range(s_off):
+            vals = jnp.where(col == OFF - s_off + j, zst[:, j][:, None], vals)
+            vals = jnp.where(col == j, zst[:, s_off + j][:, None], vals)
+        for s in range(1, m + 1):
+            prev = ring[s - 1, i % 2]
+            cent = ring[s - 1, (i + 1) % 2]
+            ring[s - 1, i % 2] = vals
+            val = (
+                prev
+                + vals
+                + roll(cent, 1, 0)
+                + roll(cent, -1, 0)
+                + roll(cent, 1, 1)
+                + roll(cent, -1, 1)
+            ) / 6.0
+            x_g = jax.lax.rem(
+                origin_ref[0] + jnp.int32(gx) + i - jnp.int32(s + s_off), jnp.int32(gx)
+            )
+            val = jnp.where(d2v < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
+            val = jnp.where(d2v < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
+            vals = val.astype(vals.dtype)
+        out_ref[0] = vals[:, OFF:]  # level-m plane i-m, interior lanes
+        # outgoing slabs: top interior cols [Zi-s, Zi) = lanes [W-s, W)
+        # (the -z-bound message), bottom cols [0, s) = lanes [OFF, OFF+s)
+        emit = jnp.concatenate(
+            [vals[:, W - s_off : W], vals[:, OFF : OFF + s_off]], axis=1
+        )
+        zout_ref[0] = jnp.swapaxes(emit, 0, 1)
+
+    out_idx = lambda i: (jnp.maximum(i - m, 0), 0, 0)
+    return pl.pallas_call(
+        kernel,
+        grid=(Xr,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, Yr, Zi), lambda i: (i, 0, 0)),
+            pl.BlockSpec((Yr, W), lambda i: (0, 0)),  # resident d2
+            pl.BlockSpec((1, 2 * s_off, Yr), lambda i: (i, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, Yr, Zi), out_idx),
+            pl.BlockSpec((1, 2 * s_off, Yr), out_idx),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((Xr, Yr, Zi), raw.dtype),
+            jax.ShapeDtypeStruct((Xr, 2 * s_off, Yr), raw.dtype),
+        ),
+        input_output_aliases={1: 0} if alias else {},
+        scratch_shapes=[pltpu.VMEM((m, 2, Yr, W), raw.dtype)],
+        interpret=interpret,
+        **_tpu_compiler_params(interpret),
+    )(origin.astype(jnp.int32), raw, d2, z_slabs)
+
+
 def jacobi_slab_step(
     block: jax.Array,  # (X, Y, Z) bare interior — NO carried shell
     xlo: jax.Array,  # (Y, Z)  received from -x neighbor (its top plane)

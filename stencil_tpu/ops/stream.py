@@ -582,10 +582,14 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
         groups = [[q] for q in range(len(names))]
     else:
         groups = [list(range(len(names)))]
-    # Un-aliased wavefront passes are ~10-20% faster (probe21b) but cost one
-    # fresh raw-sized buffer per field in flight; with many fields that
-    # doubles a multi-GB working set and can exhaust HBM, so alias (run
-    # in-place) from 4 fields up.  STENCIL_STREAM_ALIAS=0/1 overrides.
+    # Un-aliased wavefront passes are ~10-20% faster for FEW fields
+    # (probe21b: the in-place alias serializes the deep-m pipeline) but cost
+    # one fresh raw-sized buffer per pass.  From 4 fields up, alias: a joint
+    # pass would double a multi-GB working set (8 x ~700 MB exhausted HBM in
+    # bench), and even per-field grouped passes measured ~50% SLOWER
+    # un-aliased at 8x512^3 (19.1 vs 12.8 ms/iter, r5 bench) — the per-pass
+    # allocate/free churn costs more than the aliasing serialization saves.
+    # STENCIL_STREAM_ALIAS=0/1 overrides.
     import os as _os
 
     _alias_env = _os.environ.get("STENCIL_STREAM_ALIAS", "auto")

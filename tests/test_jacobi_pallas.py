@@ -126,6 +126,38 @@ def test_slab_forced_rejects_unaligned_x_on_tpu(monkeypatch):
         m.realize()
 
 
+def test_wavefront_z_ring_matches_jnp(monkeypatch):
+    """The z-RING layout (lane-aligned shard z interior: z shell absent from
+    HBM, halo segments ring-wrapped in the VMEM working plane) must equal
+    the XLA formulation exactly up to fusion ulp."""
+    monkeypatch.delenv("STENCIL_Z_RING", raising=False)
+    devs = jax.devices()[:2]
+
+    def mk(**kw):
+        m = Jacobi3D(16, 16, 128, devices=devs, **kw)
+        m.dd.set_partition(2, 1, 1)  # keep the z axis whole (shard z = 128)
+        m.realize()
+        return m
+
+    a = mk()
+    b = mk(kernel_impl="pallas", pallas_path="wavefront", temporal_k=2,
+           interpret=True)
+    assert b._wavefront_z_slabs and b._wavefront_z_ring
+    a.step(5)
+    b.step(5)  # 2 macros + depth-1 remainder
+    np.testing.assert_allclose(a.temperature(), b.temperature(),
+                               rtol=1e-6, atol=1e-6)
+
+    # and the env escape hatch restores the padded layout, same values
+    monkeypatch.setenv("STENCIL_Z_RING", "0")
+    c = mk(kernel_impl="pallas", pallas_path="wavefront", temporal_k=2,
+           interpret=True)
+    assert c._wavefront_z_slabs and not c._wavefront_z_ring
+    c.step(5)
+    np.testing.assert_allclose(b.temperature(), c.temperature(),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_wavefront_accepts_uneven_on_plain_variant():
     """Padded sizes run the wavefront's PLAIN kernel variant (full-speed
     uneven support, partition.hpp:83-114 parity); see test_uneven.py for the

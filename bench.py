@@ -137,8 +137,11 @@ def main() -> None:
     # engine — the user-kernel path, not a bespoke kernel
     from stencil_tpu.models.astaroth import AstarothSim
 
+    # schedule forced to the wavefront so the artifact keeps measuring the
+    # COMM-BEARING production path (the engine's auto would pick the
+    # no-exchange wrap route on one device)
     ast = AstarothSim(size, size, size, num_quantities=8, devices=[dev],
-                      kernel_impl="pallas")
+                      kernel_impl="pallas", schedule="wavefront")
     ast.realize()
     ast_iters = 24
     ast.step(ast_iters)

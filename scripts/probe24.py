@@ -43,7 +43,7 @@ def main():
         @functools.partial(jax.jit, static_argnums=0, donate_argnums=tuple(range(1, nargs+1)))
         def go(reps, *arrs):
             f = jax.shard_map(fn_body, mesh=mesh,
-                              in_specs=(P(),) + tuple(spec for _ in arrs) if False else tuple(spec for _ in arrs),
+                              in_specs=tuple(spec for _ in arrs),
                               out_specs=tuple(spec for _ in arrs) if nargs > 1 else spec,
                               check_vma=False)
             def body(_, a):

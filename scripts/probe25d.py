@@ -1,11 +1,13 @@
-"""Probe25d: tight A/B of ring vs padded z-slab wavefront at m=16, alternating
-timed runs on co-resident models so contention hits both equally."""
-import os, time
+"""Probe25d: tight A/B of ring vs padded z-slab wavefront, alternating timed
+runs on co-resident models so contention hits both equally.  Depth via argv:
+``python probe25d.py 16`` (default 8) — the PERF_NOTES record ran both."""
+import os, sys, time
 import jax, jax.numpy as jnp
 from stencil_tpu.bin._common import host_round_trip_s
 from stencil_tpu.models.jacobi import Jacobi3D
 
-def build(ring, m=8, n=512):
+def build(ring, m=None, n=512):
+    m = m or M
     os.environ["STENCIL_Z_RING"] = "1" if ring else "0"
     model = Jacobi3D(n, n, n, devices=jax.devices()[:1], kernel_impl="pallas",
                      pallas_path="wavefront", temporal_k=m)
@@ -15,6 +17,9 @@ def build(ring, m=8, n=512):
     model.step(steps)
     float(jnp.sum(model.dd.get_curr(model.h)[0,0,0:1]))
     return model, steps
+
+M = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+
 
 def main():
     rt = host_round_trip_s()

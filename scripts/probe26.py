@@ -1,0 +1,47 @@
+"""Probe26: user kernels on the engine WRAP route at 512^3 single chip."""
+import time
+import jax, jax.numpy as jnp
+from stencil_tpu.bin._common import host_round_trip_s
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+
+def mean6(views, info):
+    return {n: (s.sh(-1,0,0)+s.sh(0,-1,0)+s.sh(0,0,-1)
+                +s.sh(1,0,0)+s.sh(0,1,0)+s.sh(0,0,1))/6.0
+            for n, s in views.items()}
+
+def forced(views, info):
+    src = views["u"]
+    cx, cy, cz = info.coords()
+    g = info.global_size
+    val = (src.sh(-1,0,0)+src.sh(0,-1,0)+src.sh(0,0,-1)
+           +src.sh(1,0,0)+src.sh(0,1,0)+src.sh(0,0,1))/6.0
+    d2 = (cx-g.x//3)**2 + (cy-g.y//2)**2 + (cz-g.z//2)**2
+    return {"u": jnp.where(d2 < (g.x//10+1)**2, 1.0, val).astype(src.center().dtype)}
+
+def main():
+    rt = host_round_trip_s()
+    n = 512
+    for label, kern in (("mean6", mean6), ("forced (jacobi-like)", forced)):
+        dd = DistributedDomain(n, n, n)
+        dd.set_radius(Radius.constant(1))
+        dd.set_devices(jax.devices()[:1])
+        h = dd.add_data("u")
+        dd.realize()
+        dd.init_by_coords(h, lambda x, y, z: jnp.sin(0.01*(x+y+z)))
+        step = dd.make_step(kern, engine="stream")
+        plan = step._stream_plan
+        steps = 96 // plan["m"] * plan["m"]
+        dd.run_step(step, steps)
+        float(jnp.sum(dd.get_curr(h)[0,0,0:1]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            dd.run_step(step, steps)
+            float(jnp.sum(dd.get_curr(h)[0,0,0:1]))
+            best = min(best, (time.perf_counter() - t0 - rt) / steps)
+        print(f"{label}: {n**3/best/1e6:,.0f} Mcells/s (plan={plan})", flush=True)
+        del dd, step
+
+if __name__ == "__main__":
+    main()

@@ -680,7 +680,9 @@ class DistributedDomain:
         donate: bool = True,
         engine: str = "xla",
         x_radius: int = None,
-        stream_path: str = "auto",  # stream engine route: auto|plane|wavefront
+        stream_path: str = "auto",  # stream engine route:
+        # auto|wrap|plane|wavefront (auto: wrap on one device, wavefront
+        # when a shell >= 2 allows temporal blocking, plane otherwise)
         separable: bool = False,  # stream engine: kernel is correct on view
         # subsets (each field reads only itself) -> per-field passes may
         # replace the joint pass when many fields blow the VMEM model

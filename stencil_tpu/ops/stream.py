@@ -367,6 +367,81 @@ def stream_wavefront_pass(
     return outs, None
 
 
+def stream_wrap_pass(
+    kernel: PlaneKernel,
+    names: Sequence[str],
+    blocks: Sequence[jax.Array],  # per-quantity BARE (X, Y, Z) interiors
+    k: int,  # temporal depth (1 <= k <= X//2)
+    origin: jax.Array,  # (3,) int32 — global coords of the block start
+    global_size: Dim3,
+    interpret: bool = False,
+) -> List[jax.Array]:
+    """``k`` kernel levels over the WHOLE (single-device) domain with the
+    periodic wrap folded in — the user-kernel generalization of
+    ``jacobi_wrap_step`` (see its docstring: the x-wrap rides the modular
+    block index map with a ``2k``-step replay closing every level's ring;
+    the y/z wrap is the natural roll wraparound on exact-sized planes).
+    No shell, no exchange, ~8/k HBM bytes per cell per iteration."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nq = len(names)
+    X, Y, Z = blocks[0].shape
+    assert 1 <= k <= X // 2, (k, X)
+    roll = _make_roll(interpret)
+    gsize = global_size
+
+    def body(origin_ref, *refs):
+        in_refs = refs[:nq]
+        out_refs = refs[nq : 2 * nq]
+        rings = refs[2 * nq :]
+        i = pl.program_id(0)
+        vals = [ref[0] for ref in in_refs]  # level-0 plane i (mod X)
+        y_g, z_g = _yz_coord_planes(origin_ref, Y, Z, 0, 0, gsize)
+        for s in range(1, k + 1):
+            prevs = [rings[q][s - 1, i % 2] for q in range(nq)]
+            cents = [rings[q][s - 1, (i + 1) % 2] for q in range(nq)]
+            for q in range(nq):
+                rings[q][s - 1, i % 2] = vals[q]
+            views = {
+                names[q]: PlaneView((prevs[q], cents[q], vals[q]), roll)
+                for q in range(nq)
+            }
+            x_g = lax.rem(
+                origin_ref[0] + jnp.int32(gsize.x) + i - jnp.int32(s),
+                jnp.int32(gsize.x),
+            )
+            info = PlaneInfo(x_g, y_g, z_g, gsize, s)
+            new = kernel(views, info)
+            vals = [
+                new[names[q]].astype(cents[q].dtype)
+                if names[q] in new
+                else cents[q]
+                for q in range(nq)
+            ]
+        for q in range(nq):
+            out_refs[q][0] = vals[q]  # level-k plane (i - k) % X
+
+    outs = pl.pallas_call(
+        body,
+        grid=(X + 2 * k,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec((1, Y, Z), lambda i: (i % X, 0, 0)) for _ in range(nq)],
+        out_specs=tuple(
+            pl.BlockSpec((1, Y, Z), lambda i: ((i - k) % X, 0, 0))
+            for _ in range(nq)
+        ),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((X, Y, Z), b.dtype) for b in blocks
+        ),
+        scratch_shapes=[pltpu.VMEM((k, 2, Y, Z), b.dtype) for b in blocks],
+        interpret=interpret,
+        **_tpu_compiler_params(interpret),
+    )(origin.astype(jnp.int32), *blocks)
+    # out_shape is always a tuple, so pallas returns a tuple even for nq=1
+    return list(outs)
+
+
 def stream_vmem_fits(
     m: int, plane_y: int, plane_z: int, itemsizes: Sequence[int], z_slabs: bool
 ) -> bool:
@@ -388,11 +463,14 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
                 max_m: int = None) -> dict:
     """Route planning for ``make_stream_step`` on a REALIZED domain.
 
-    Returns ``{"route": "wavefront"|"plane", "m": int, "z_slabs": bool}``.
-    Wavefront needs: x_radius 1, uniform face shell >= 2; depth m = the
-    deepest level count that fits the VMEM model, capped by the shell width
-    and the measured plateau (_WRAP_MAX_K).  The plane route covers
-    everything else the engine supports.
+    Returns ``{"route": "wrap"|"wavefront"|"plane", "m": int,
+    "z_slabs": bool, "grouping": str}``.  On a SINGLE subdomain the wrap
+    route wins (periodic boundary folded into the kernel: no shell reads,
+    no exchange, deepest temporal blocking).  Wavefront needs: x_radius 1,
+    uniform face shell >= 2; depth m = the deepest level count that fits
+    the VMEM model, capped by the shell width and the measured plateau
+    (_WRAP_MAX_K).  The plane route covers everything else the engine
+    supports.
 
     PADDED (uneven) shards run BOTH routes: the exchange blends each halo at
     the dynamic valid-width offset, i.e. contiguously after the valid cells,
@@ -423,7 +501,7 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
     """
     if any(h.components for h in dd._handles):
         raise ValueError("the streaming engine does not support N-D component data")
-    if path not in ("auto", "plane", "wavefront"):
+    if path not in ("auto", "plane", "wavefront", "wrap"):
         raise ValueError(f"unknown stream path {path!r}")
     padded = any(v is not None for v in dd._valid_last)
     shell = dd._shell_radius
@@ -436,6 +514,33 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
     uniform = len({lo.x, lo.y, lo.z, hi.x, hi.y, hi.z}) == 1
     s = lo.x
     itemsizes = [h.dtype.itemsize for h in dd._handles]
+    # single device: the WRAP route folds the periodic boundary into the
+    # kernel's index maps/rotates — no shell reads, no exchange, the deepest
+    # temporal blocking (the user-kernel analog of jacobi_wrap_step)
+    if path in ("auto", "wrap") and dd.num_subdomains() == 1 and x_radius == 1:
+        cap = min(_WRAP_MAX_K, n.x // 2)
+        if max_m is not None:
+            cap = min(cap, max_m)
+        best = None
+        for grouping, sizes in (
+            [("joint", itemsizes)]
+            + ([("per-field", [max(itemsizes)])] if separable and len(itemsizes) > 1 else [])
+        ):
+            k = 0
+            for cand in range(1, cap + 1):
+                if stream_vmem_fits(cand, n.y, n.z, sizes, False):
+                    k = cand
+            # deepest k across groupings — depth is the traffic lever
+            # (~8/k B/cell/iter); joint wins ties
+            if k >= 1 and (best is None or k > best["m"]):
+                best = {"route": "wrap", "m": k, "z_slabs": False, "grouping": grouping}
+        if best is not None:
+            return best
+    if path == "wrap":
+        raise ValueError(
+            "path='wrap' needs a single subdomain with >= 2 x-planes, "
+            "x_radius 1, and VMEM for at least one resident plane ring"
+        )
     if path != "plane" and x_radius == 1 and uniform and s >= 2:
         # (No shell-traffic heuristic here: the shell width s is GIVEN — the
         # domain already allocated and exchanges it — so advancing more
@@ -600,7 +705,37 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
             [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
         )
 
-    if plan["route"] == "plane":
+    if plan["route"] == "wrap":
+        k = plan["m"]
+
+        def per_shard(steps, *blocks_raw):
+            origin = origin_of()
+            bs = tuple(
+                lax.slice(b, (lo.x, lo.y, lo.z), (lo.x + n.x, lo.y + n.y, lo.z + n.z))
+                for b in blocks_raw
+            )
+
+            def one(depth, bs):
+                out = list(bs)
+                for g in groups:
+                    outs = stream_wrap_pass(
+                        kernel, [names[q] for q in g], [bs[q] for q in g],
+                        depth, origin, gsize, interpret=interpret,
+                    )
+                    for q, o in zip(g, outs):
+                        out[q] = o
+                return tuple(out)
+
+            blocked, rem = divmod(steps, k)
+            bs = lax.fori_loop(0, blocked, lambda _, b: one(k, b), bs)
+            if rem:
+                bs = one(rem, bs)
+            return tuple(
+                lax.dynamic_update_slice(rb, b, (lo.x, lo.y, lo.z))
+                for rb, b in zip(blocks_raw, bs)
+            )
+
+    elif plan["route"] == "plane":
 
         def per_shard(steps, *blocks):
             origin = origin_of()
@@ -751,16 +886,21 @@ def make_stream_step(
                 return state["impl"](curr, steps)
             except Exception as e:  # jax wraps Mosaic failures variously
                 plan_now = state["plan"]
-                if not (_is_vmem_oom(e) and plan_now["route"] == "wavefront"):
+                if not (
+                    _is_vmem_oom(e)
+                    and plan_now["route"] in ("wavefront", "wrap")
+                    and plan_now["m"] > 1
+                ):
                     raise
                 from stencil_tpu.utils.logging import log_warn
 
                 new_max = plan_now["m"] - 1
                 log_warn(
-                    f"wavefront depth m={plan_now['m']} exceeded the compiler's "
-                    f"scoped-VMEM budget at runtime; stepping down to m<={new_max} "
-                    "(the VMEM model under-estimates on this toolchain — consider "
-                    "recalibrating _VMEM_STACK_MARGIN / STENCIL_VMEM_LIMIT_BYTES)"
+                    f"{plan_now['route']} depth m={plan_now['m']} exceeded the "
+                    f"compiler's scoped-VMEM budget at runtime; stepping down to "
+                    f"m<={new_max} (the VMEM model under-estimates on this "
+                    "toolchain — consider recalibrating _VMEM_STACK_MARGIN / "
+                    "STENCIL_VMEM_LIMIT_BYTES)"
                 )
                 state["plan"] = plan_stream(dd, x_radius, path, separable, max_m=new_max)
                 state["impl"] = _build_stream_step(

@@ -101,9 +101,18 @@ def test_compiled_stream_engine_matches_xla():
     dd_ref.run_step(ref, 6)
     want = dd_ref.quantity_to_host(h_ref)
 
-    for mult, route in ((1, "plane"), (3, "wavefront")):
+    # single device auto-routes WRAP; forced plane and the wavefront (via a
+    # halo multiplier) cover the other two routes — all compiled by Mosaic
+    # on one device auto always prefers WRAP (even with a halo multiplier:
+    # the self-permuted wavefront cannot beat the no-shell wrap), so the
+    # wavefront is forced explicitly to get compiled coverage here
+    for mult, path, route in (
+        (1, "auto", "wrap"),
+        (1, "plane", "plane"),
+        (3, "wavefront", "wavefront"),
+    ):
         dd, h = mk(mult)
-        step = dd.make_step(kern, engine="stream")  # compiled Mosaic
+        step = dd.make_step(kern, engine="stream", stream_path=path)
         assert step._stream_plan["route"] == route
         dd.run_step(step, 6)
         np.testing.assert_array_equal(want, dd.quantity_to_host(h))

@@ -107,7 +107,10 @@ def forced_kernel(views, info):
     return {"u": jnp.where(d2 < 9, 1.0, val).astype(src.center().dtype)}
 
 
-def test_stream_plane_route_single_device():
+def test_stream_wrap_route_single_device():
+    """One device: the engine folds the periodic wrap into the kernel (no
+    shell, no exchange, deepest temporal blocking) — jacobi_wrap_step's
+    structure for USER kernels."""
     dev = jax.devices()[:1]
     r1 = Radius.constant(1)
     outs, step = _run_both(
@@ -115,7 +118,48 @@ def test_stream_plane_route_single_device():
         lambda: _mk(12, 10, 11, r1, ["u"], dev),
         mean6_kernel, 3,
     )
-    assert step._stream_plan["route"] == "plane"  # shell 1: no wavefront
+    assert step._stream_plan["route"] == "wrap"
+    assert step._stream_plan["m"] >= 2
+    for a, b in outs:
+        np.testing.assert_allclose(a, b, **TOL)
+
+
+def test_stream_plane_route_single_device_forced():
+    dev = jax.devices()[:1]
+    r1 = Radius.constant(1)
+    dd_a, hs_a = _mk(12, 10, 11, r1, ["u"], dev)
+    dd_b, hs_b = _mk(12, 10, 11, r1, ["u"], dev)
+    step_a = dd_a.make_step(mean6_kernel, overlap=False)
+    step_b = dd_b.make_step(mean6_kernel, engine="stream", stream_path="plane",
+                            interpret=True)
+    assert step_b._stream_plan["route"] == "plane"
+    dd_a.run_step(step_a, 3)
+    dd_b.run_step(step_b, 3)
+    np.testing.assert_allclose(
+        dd_a.quantity_to_host(hs_a[0]), dd_b.quantity_to_host(hs_b[0]), **TOL
+    )
+
+
+def test_stream_wrap_route_forcing_and_multifield():
+    """Wrap route with coordinate forcing and a pass-through second field;
+    steps not a multiple of k exercise the remainder dispatch."""
+    dev = jax.devices()[:1]
+    r1 = Radius.constant(1)
+    outs, step = _run_both(
+        lambda: _mk(16, 16, 16, r1, ["u", "c"], dev),
+        lambda: _mk(16, 16, 16, r1, ["u", "c"], dev),
+        vc_diffusion_kernel, 5,
+    )
+    assert step._stream_plan["route"] == "wrap"
+    (ua, ub), (ca, cb) = outs
+    np.testing.assert_allclose(ua, ub, **TOL)
+    np.testing.assert_array_equal(ca, cb)
+
+    outs, _ = _run_both(
+        lambda: _mk(16, 16, 16, r1, ["u"], dev),
+        lambda: _mk(16, 16, 16, r1, ["u"], dev),
+        forced_kernel, 5,
+    )
     for a, b in outs:
         np.testing.assert_allclose(a, b, **TOL)
 
@@ -369,6 +413,26 @@ def test_stream_runtime_vmem_fallback(monkeypatch):
     np.testing.assert_allclose(
         ref_dd.quantity_to_host(ref_hs[0]), dd.quantity_to_host(hs[0]), **TOL
     )
+
+
+def test_stream_bf16_wavefront():
+    """bf16 fields through the engine: rolls upcast to f32 in compiled mode
+    (interpret uses jnp.roll directly); parity vs the XLA engine at bf16
+    resolution."""
+    devs = jax.devices()[:8]
+    r1 = Radius.constant(1)
+    outs, step = _run_both(
+        lambda: _mk(24, 24, 24, r1, ["u"], devs, dtype=jnp.bfloat16),
+        lambda: _mk(24, 24, 24, r1, ["u"], devs, mult=2, dtype=jnp.bfloat16),
+        mean6_kernel,
+        4,
+    )
+    assert step._stream_plan["route"] == "wavefront"
+    for a, b in outs:
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-2, atol=2e-2,  # bf16 resolution over 4 steps
+        )
 
 
 def test_jacobi_bespoke_vmem_fallback():

@@ -1,0 +1,48 @@
+"""Probe26b: 27-point and vc-diffusion user kernels on the WRAP route."""
+import time
+import jax, jax.numpy as jnp
+from stencil_tpu.bin._common import host_round_trip_s
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+
+def k27(views, info):
+    src = views["u"]
+    acc = 0.0
+    for dx in (-1,0,1):
+        for dy in (-1,0,1):
+            for dz in (-1,0,1):
+                acc = acc + src.sh(dx,dy,dz) / (2.0 ** (abs(dx)+abs(dy)+abs(dz)))
+    return {"u": acc / 8.0}
+
+def vc(views, info):
+    u, c = views["u"], views["c"]
+    lap = (u.sh(-1,0,0)+u.sh(1,0,0)+u.sh(0,-1,0)+u.sh(0,1,0)
+           +u.sh(0,0,-1)+u.sh(0,0,1) - 6.0*u.center())
+    return {"u": u.center() + c.center()*lap}
+
+def main():
+    rt = host_round_trip_s()
+    n = 512
+    for label, names, kern, depth in (("27pt d2", ["u"], k27, 2), ("27pt d4", ["u"], k27, 4), ("vc-diffusion d8", ["u","c"], vc, 8)):
+        dd = DistributedDomain(n, n, n)
+        dd.set_radius(Radius.constant(1)); dd.set_devices(jax.devices()[:1])
+        hs = [dd.add_data(nm) for nm in names]
+        dd.realize()
+        for h in hs:
+            dd.init_by_coords(h, lambda x, y, z: 0.2 + 0.001*jnp.sin(0.01*(x+y+z)))
+        step = dd.make_step(kern, engine="stream", stream_depth=depth)
+        plan = step._stream_plan
+        steps = 96 // plan["m"] * plan["m"]
+        dd.run_step(step, steps)
+        float(jnp.sum(dd.get_curr(hs[0])[0,0,0:1]))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            dd.run_step(step, steps)
+            float(jnp.sum(dd.get_curr(hs[0])[0,0,0:1]))
+            best = min(best, (time.perf_counter() - t0 - rt) / steps)
+        print(f"{label}: {n**3/best/1e6:,.0f} Mcells/s (plan={plan})", flush=True)
+        del dd, step
+
+if __name__ == "__main__":
+    main()

@@ -686,6 +686,9 @@ class DistributedDomain:
         separable: bool = False,  # stream engine: kernel is correct on view
         # subsets (each field reads only itself) -> per-field passes may
         # replace the joint pass when many fields blow the VMEM model
+        stream_depth: int = None,  # stream engine: cap the temporal depth
+        # (auto maximizes it — the right call for bandwidth-bound kernels,
+        # wrong for compute-heavy ones, whose VPU work scales with depth)
         interpret: bool = False,  # stream engine only: pallas interpret mode
     ):
         """Build ``step(curr) -> next`` fusing exchange + compute.
@@ -729,6 +732,7 @@ class DistributedDomain:
             return make_stream_step(
                 self, kernel, x_radius=x_radius, path=stream_path,
                 separable=separable, interpret=interpret, donate=donate,
+                max_depth=stream_depth,
             )
         if engine != "xla":
             raise ValueError(f"unknown engine {engine!r}")

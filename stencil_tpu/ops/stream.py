@@ -854,6 +854,7 @@ def make_stream_step(
     separable: bool = False,
     interpret: bool = False,
     donate: bool = True,
+    max_depth: int = None,
 ):
     """Build a ``step(curr, steps) -> curr`` running ``kernel`` under the
     plane-streaming engine — the fast-by-default path for user stencils
@@ -868,13 +869,24 @@ def make_stream_step(
     view subsets, letting many-field domains stream per-field (see
     ``plan_stream``).
 
+    ``max_depth`` caps the temporal depth (wrap k / wavefront m).  The auto
+    planner maximizes depth because depth is the HBM-traffic lever
+    (~bytes/k per cell) — correct for bandwidth-bound kernels, but a
+    COMPUTE-heavy kernel (e.g. 27 taps/cell) multiplies its VPU work by the
+    depth with nothing to amortize; cap it low (2-4) for such kernels.
+
     The returned step carries a RUNTIME fallback: if Mosaic rejects the
     planned wavefront depth (scoped-VMEM OOM — the model under-estimated on
     this toolchain), the step rebuilds one level shallower and retries,
     logging a recalibration hint, until the plane route is reached.  The
     current plan is exposed as ``step._stream_plan``.
     """
-    plan = plan_stream(dd, x_radius, path, separable)
+    if max_depth is not None and max_depth < 1:
+        raise ValueError(
+            f"stream_depth must be >= 1, got {max_depth} (a 0/negative cap "
+            "would silently disable temporal blocking)"
+        )
+    plan = plan_stream(dd, x_radius, path, separable, max_m=max_depth)
     state = {
         "plan": plan,
         "impl": _build_stream_step(dd, kernel, x_radius, plan, interpret, donate),

@@ -415,6 +415,34 @@ def test_stream_runtime_vmem_fallback(monkeypatch):
     )
 
 
+def test_stream_depth_cap():
+    """stream_depth caps the temporal depth (compute-heavy kernels multiply
+    their VPU work by the depth; the auto planner maximizes it for the
+    bandwidth-bound case)."""
+    dev = jax.devices()[:1]
+    r1 = Radius.constant(1)
+    outs, step = _run_both(
+        lambda: _mk(16, 16, 16, r1, ["u"], dev),
+        lambda: _mk(16, 16, 16, r1, ["u"], dev),
+        stencil27_kernel, 5,
+    )
+    assert step._stream_plan == {
+        "route": "wrap", "m": 8, "z_slabs": False, "grouping": "joint",
+    }
+    for a, b in outs:  # uncapped wrap vs the XLA ground truth
+        np.testing.assert_allclose(a, b, **TOL)
+    dd, hs = _mk(16, 16, 16, r1, ["u"], dev)
+    capped = dd.make_step(stencil27_kernel, engine="stream", stream_depth=2,
+                          interpret=True)
+    assert capped._stream_plan["m"] == 2
+    dd.run_step(capped, 5)
+    # capped wrap vs the XLA ground truth (not just vs its uncapped sibling)
+    np.testing.assert_allclose(outs[0][0], dd.quantity_to_host(hs[0]), **TOL)
+    with pytest.raises(ValueError, match="stream_depth"):
+        dd.make_step(stencil27_kernel, engine="stream", stream_depth=0,
+                     interpret=True)
+
+
 def test_stream_bf16_wavefront():
     """bf16 fields through the engine: rolls upcast to f32 in compiled mode
     (interpret uses jnp.roll directly); parity vs the XLA engine at bf16

@@ -19,11 +19,13 @@ exchanges 8 fields).
 
 The pallas path runs ``_kernel`` VERBATIM under the plane-streaming engine
 (``ops/stream.py``): the default ``schedule="auto"`` upgrades to the m-level
-temporal wavefront (m <= 3 — the depth a radius-3 shell feeds for distance-1
-reads) whenever shards are even, ~2.6x faster at 512^3 than the per-step
-schedule; ``--schedule per-step`` restores exact exchange-cadence parity with
-the reference (one exchange per iteration, modeling Astaroth's real
-communication volume).
+temporal wavefront — m <= 3 x the halo multiplier, since the radius-3 shell
+feeds 3 levels of the distance-1 stencil per multiplier step (a
+``set_halo_multiplier(2)`` run wavefronts 6 levels per exchange) — whenever
+shards are even, ~2.6x faster at 512^3 than the per-step schedule; on one
+device it upgrades further to the exchange-free wrap route.  ``--schedule
+per-step`` restores exact exchange-cadence parity with the reference (one
+exchange per iteration, modeling Astaroth's real communication volume).
 """
 
 from __future__ import annotations
@@ -54,12 +56,12 @@ class AstarothSim:
         interpret: bool = False,
         schedule: str = "auto",  # "auto" (DEFAULT: the radius-3 shell
         # already feeds 3 levels of the distance-1 stencil, so exchange
-        # every m <= 3 steps and run an m-level wavefront kernel — same
-        # field values up to last-ulp fusion effects, ~1/m the traffic;
-        # falls back to per-step when the wavefront is not viable, e.g.
-        # uneven sizes) | "wavefront" (forced: raises when not viable) |
-        # "per-step" (reference parity escape hatch: exchange every
-        # iteration, modeling Astaroth's real communication volume —
+        # every m steps (m <= 3 x the halo multiplier) and run an m-level
+        # wavefront kernel — same field values up to last-ulp fusion
+        # effects, ~1/m the traffic; a single device upgrades to the
+        # exchange-free wrap route) | "wavefront" (forced: raises when not
+        # viable) | "per-step" (reference parity escape hatch: exchange
+        # every iteration, modeling Astaroth's real communication volume —
         # astaroth_sim.cu:223-274)
     ):
         self.dd = DistributedDomain(x, y, z)
@@ -88,10 +90,14 @@ class AstarothSim:
         if self.kernel_impl == "pallas":
             # the plane-streaming ENGINE (ops/stream.py) runs the model's own
             # _kernel verbatim: per-step exchange = plane route, wavefront
-            # schedule = the engine's m-level temporal route (m <= 3, the
-            # depth the radius-3 shell feeds for distance-1 reads)
-            if self.dd.halo_multiplier() != 1:
-                raise ValueError("pallas path requires halo multiplier 1")
+            # schedule = the engine's m-level temporal route (m <= 3 x the
+            # halo multiplier — the radius-3 shell feeds 3 levels of the
+            # distance-1 stencil per multiplier step)
+            if self.dd.halo_multiplier() != 1 and self.schedule == "per-step":
+                raise ValueError(
+                    "schedule='per-step' (exchange-cadence parity) "
+                    "contradicts a halo multiplier; use schedule='auto'"
+                )
             if not self.overlap:
                 raise ValueError(
                     "overlap=False has no meaning for the fused pallas step; "

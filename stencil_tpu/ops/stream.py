@@ -881,10 +881,12 @@ def make_stream_step(
     logging a recalibration hint, until the plane route is reached.  The
     current plan is exposed as ``step._stream_plan``.
     """
-    if max_depth is not None and max_depth < 1:
+    if max_depth is not None and (
+        not isinstance(max_depth, int) or max_depth < 1
+    ):
         raise ValueError(
-            f"stream_depth must be >= 1, got {max_depth} (a 0/negative cap "
-            "would silently disable temporal blocking)"
+            f"stream_depth must be an int >= 1, got {max_depth!r} (a "
+            "0/negative cap would silently disable temporal blocking)"
         )
     plan = plan_stream(dd, x_radius, path, separable, max_m=max_depth)
     state = {

@@ -56,6 +56,27 @@ def test_astaroth_wavefront_schedule_matches_per_step():
     np.testing.assert_array_equal(a1.field(0), b1.field(0))
 
 
+def test_astaroth_halo_multiplier_deepens_wavefront():
+    """A halo multiplier widens the radius-3 shell, letting the engine
+    wavefront deeper than 3 levels per exchange — same field values."""
+    a = AstarothSim(32, 32, 32, kernel_impl="pallas", interpret=True,
+                    schedule="per-step")
+    a.realize()
+    b = AstarothSim(32, 32, 32, kernel_impl="pallas", interpret=True)
+    b.dd.set_halo_multiplier(2)  # shell 6 -> m up to 6
+    b.realize()
+    assert b._wavefront_m == 6, b._wavefront_m
+    a.step(7)
+    b.step(7)  # one macro + a shallower remainder
+    np.testing.assert_allclose(a.field(), b.field(), rtol=1e-6, atol=1e-6)
+
+    with pytest.raises(ValueError, match="per-step"):
+        c = AstarothSim(32, 32, 32, kernel_impl="pallas", interpret=True,
+                        schedule="per-step")
+        c.dd.set_halo_multiplier(2)
+        c.realize()
+
+
 def test_astaroth_wavefront_uneven_and_jnp_guard():
     # uneven sizes run the wavefront's PLAIN variant at full depth now
     m = AstarothSim(15, 14, 13, kernel_impl="pallas", interpret=True,

@@ -89,7 +89,10 @@ def main() -> None:
         model.step(iters)
         float(jnp.sum(model.dd.get_curr(model.h)))  # force completion
         dt = float("inf")
-        for _ in range(4):  # best-of-4 on a possibly time-shared chip
+        # best-of-8: each attempt is ~0.1-0.3 s and the chip is time-shared
+        # with minute-scale contention waves, so more cheap attempts beat
+        # longer ones for catching a quiet window
+        for _ in range(8):
             t0 = time.perf_counter()
             model.step(iters)
             float(jnp.sum(model.dd.get_curr(model.h)))

@@ -87,17 +87,23 @@ class AstarothSim:
         w = 2 * math.pi / self.period
         for h in self.handles:
             self.dd.init_by_coords(h, lambda x, y, z: jnp.sin(w * (x + y + z)))
+        if self.dd.halo_multiplier() != 1 and self.schedule == "per-step":
+            # on EITHER kernel_impl a multiplier means fewer, wider
+            # exchanges — the opposite of the cadence 'per-step' promises
+            raise ValueError(
+                "schedule='per-step' (exchange-cadence parity) "
+                "contradicts a halo multiplier; use schedule='auto'"
+            )
         if self.kernel_impl == "pallas":
             # the plane-streaming ENGINE (ops/stream.py) runs the model's own
             # _kernel verbatim: per-step exchange = plane route, wavefront
             # schedule = the engine's m-level temporal route (m <= 3 x the
             # halo multiplier — the radius-3 shell feeds 3 levels of the
-            # distance-1 stencil per multiplier step)
-            if self.dd.halo_multiplier() != 1 and self.schedule == "per-step":
-                raise ValueError(
-                    "schedule='per-step' (exchange-cadence parity) "
-                    "contradicts a halo multiplier; use schedule='auto'"
-                )
+            # distance-1 stencil per multiplier step).
+            # NOTE on step(steps) semantics under a multiplier: the stream
+            # engine counts RAW iterations (steps), while the XLA route's
+            # macro contract (make_step docstring) advances steps x mult —
+            # compare impls at matching ITERATION counts, not step() calls.
             if not self.overlap:
                 raise ValueError(
                     "overlap=False has no meaning for the fused pallas step; "

@@ -881,13 +881,22 @@ def make_stream_step(
     logging a recalibration hint, until the plane route is reached.  The
     current plan is exposed as ``step._stream_plan``.
     """
-    if max_depth is not None and (
-        not isinstance(max_depth, int) or max_depth < 1
-    ):
-        raise ValueError(
-            f"stream_depth must be an int >= 1, got {max_depth!r} (a "
-            "0/negative cap would silently disable temporal blocking)"
-        )
+    if max_depth is not None:
+        import operator
+
+        if isinstance(max_depth, bool):  # True would cap depth at 1 silently
+            raise ValueError(f"stream_depth must be an integer, got {max_depth!r}")
+        try:
+            max_depth = operator.index(max_depth)  # int, np.int64, ...
+        except TypeError:
+            raise ValueError(
+                f"stream_depth must be an integer >= 1, got {max_depth!r}"
+            ) from None
+        if max_depth < 1:
+            raise ValueError(
+                f"stream_depth must be >= 1, got {max_depth} (a 0/negative "
+                "cap would silently disable temporal blocking)"
+            )
     plan = plan_stream(dd, x_radius, path, separable, max_m=max_depth)
     state = {
         "plan": plan,

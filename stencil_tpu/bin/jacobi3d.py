@@ -101,7 +101,7 @@ def main(argv=None) -> int:
         print(f"wrote {model.dd.write_plan(args.prefix + 'plan')}", file=sys.stderr)
 
     iter_time = Statistics()
-    model.step()  # compile outside the timed loop
+    model.step(args.halo_multiplier)  # compile outside the timed loop
     model.block_until_ready()
 
     from stencil_tpu.utils.profiling import trace
@@ -109,10 +109,10 @@ def main(argv=None) -> int:
     with trace(args.trace):
         for it in range(args.iters):
             t0 = time.perf_counter()
-            model.step()
+            model.step(args.halo_multiplier)
             model.block_until_ready()
-            # a macro step advances halo_multiplier iterations; the CSV stays
-            # per-iteration so rows are comparable across multipliers
+            # one macro (halo_multiplier raw iterations) per timed step; the
+            # CSV stays per-iteration so rows are comparable across multipliers
             iter_time.insert((time.perf_counter() - t0) / args.halo_multiplier)
             if args.paraview and it % checkpoint_period == 0:
                 from stencil_tpu.io.paraview import write_paraview

@@ -35,6 +35,12 @@ def main(argv=None) -> int:
     p.add_argument("--iters", type=int, default=50, help="controller iterations")
     p.add_argument("--sub-iters", type=int, default=3, help="timing reps per measurement")
     p.add_argument("--init-mib", type=float, default=1.0, help="initial size (MiB)")
+    p.add_argument(
+        "--max-mib", type=float, default=256.0,
+        help="per-pair size cap (MiB): a fast edge (e.g. a self-edge on one "
+        "chip, ~hundreds of GB/s) would otherwise need GB-scale buffers to "
+        "reach the 4 ms target and exhaust HBM before converging",
+    )
     p.add_argument("--tol", type=float, default=0.05, help="relative convergence tolerance")
     args = p.parse_args(argv)
 
@@ -43,10 +49,11 @@ def main(argv=None) -> int:
     mesh = Mesh(np.array(devices), ("d",))
 
     x = np.zeros((n, n))  # per-pair sizes in bytes
+    init_mib = min(args.init_mib, args.max_mib)  # the cap binds the init too
     for i in range(n):
         for j in range(n):
             if i != j or n == 1:
-                x[i, j] = args.init_mib * MiB
+                x[i, j] = init_mib * MiB
 
     for it in range(args.iters):
         y = np.zeros((n, n))
@@ -73,10 +80,17 @@ def main(argv=None) -> int:
         print(
             f"y_concurrent {measure_matrix_concurrent(mesh, x.astype(np.int64), args.sub_iters):.4e}"
         )
-        converged = np.all(np.abs(y[active] - args.target) <= args.tol * args.target)
+        # a capped pair that is still UNDER the target cannot converge (the
+        # size it needs is disallowed) — excuse it; an over-target pair can
+        # always shrink, so it must still meet tolerance
+        at_cap = (x >= args.max_mib * MiB) & (y < args.target)
+        converged = np.all(
+            (np.abs(y[active] - args.target) <= args.tol * args.target)
+            | at_cap[active]
+        )
         if converged:
             break
-        x = (x + dx).clip(4096, None) * active
+        x = (x + dx).clip(4096, args.max_mib * MiB) * active
 
     print("final x (MiB)")
     for i in range(n):

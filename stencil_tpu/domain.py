@@ -711,14 +711,17 @@ class DistributedDomain:
           operand re-reads the block from HBM (~6 reads/cell for a 7-point
           stencil).
         * ``"stream"`` — the plane-streaming engine (``ops/stream.py``):
-          x-planes ride a VMEM ring so each HBM plane is read once per pass,
-          and a uniform shell >= 2 upgrades to the temporal wavefront (m
-          levels per pass).  Requires elementwise kernels with x shifts
-          within ``x_radius`` (default: the max user radius), even shards,
-          no N-D data.  This is how USER stencils reach the flagship paths'
-          speed — the reference's user-kernel model (accessor.hpp:13-40)
-          where the cache hierarchy is an explicit plane ring.  ``overlap``
-          is not meaningful there (the macro is one fused pass).
+          x-planes ride a VMEM ring so each HBM plane is read once per pass;
+          a uniform shell >= 2 upgrades to the temporal wavefront (m levels
+          per pass, padded shards included on the plain variant) and a
+          single device to the exchange-free wrap route.  Requires
+          elementwise kernels with all shifts within ``x_radius`` (default:
+          the max user radius) and no N-D component data.  This is how USER
+          stencils reach the flagship paths' speed — the reference's
+          user-kernel model (accessor.hpp:13-40) where the cache hierarchy
+          is an explicit plane ring.  ``overlap`` is not meaningful there
+          (the macro is one fused pass); ``stream_depth`` caps the temporal
+          depth for compute-heavy kernels.
         """
         assert self._realized
         if engine == "stream":

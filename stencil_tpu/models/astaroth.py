@@ -80,7 +80,6 @@ class AstarothSim:
             raise ValueError(f"unknown schedule {schedule!r}")
         self.schedule = schedule
         self._step = None
-        self._marks_shell_stale = False
 
     def realize(self) -> None:
         self.dd.realize()
@@ -99,11 +98,8 @@ class AstarothSim:
             # _kernel verbatim: per-step exchange = plane route, wavefront
             # schedule = the engine's m-level temporal route (m <= 3 x the
             # halo multiplier — the radius-3 shell feeds 3 levels of the
-            # distance-1 stencil per multiplier step).
-            # NOTE on step(steps) semantics under a multiplier: the stream
-            # engine counts RAW iterations (steps), while the XLA route's
-            # macro contract (make_step docstring) advances steps x mult —
-            # compare impls at matching ITERATION counts, not step() calls.
+            # distance-1 stencil per multiplier step); step() counts RAW
+            # iterations on every engine (see AstarothSim.step)
             if not self.overlap:
                 raise ValueError(
                     "overlap=False has no meaning for the fused pallas step; "
@@ -154,9 +150,19 @@ class AstarothSim:
         return out
 
     def step(self, steps: int = 1) -> None:
+        """Advance ``steps`` RAW iterations — uniform across engines (the
+        stream engine counts raw iterations natively; the XLA route under a
+        halo multiplier is built in macro steps, so ``steps`` must divide
+        into whole macros there)."""
+        mult = self.dd.halo_multiplier()
+        if self.kernel_impl == "jnp" and mult > 1:
+            if steps % mult:
+                raise ValueError(
+                    f"steps={steps} must be a multiple of the halo "
+                    f"multiplier {mult} on the jnp engine (macro steps)"
+                )
+            steps //= mult
         self.dd.run_step(self._step, steps)
-        if self._marks_shell_stale:
-            self.dd.mark_shell_stale()
 
     def field(self, i: int = 0) -> np.ndarray:
         return self.dd.quantity_to_host(self.handles[i])

@@ -635,6 +635,19 @@ class Jacobi3D:
         return {"temp": val.astype(src.center().dtype)}
 
     def step(self, steps: int = 1) -> None:
+        """Advance ``steps`` RAW iterations — uniform across engines.  The
+        XLA route under a halo multiplier is built in macro steps
+        (make_step: one exchange per ``mult`` iterations), so ``steps`` must
+        divide into whole macros there; the pallas routes count raw
+        iterations natively (their wavefront manages its own multiplier)."""
+        mult = self.dd.halo_multiplier()
+        if self.kernel_impl == "jnp" and mult > 1:
+            if steps % mult:
+                raise ValueError(
+                    f"steps={steps} must be a multiple of the halo "
+                    f"multiplier {mult} on the jnp engine (macro steps)"
+                )
+            steps //= mult
         while True:
             try:
                 self.dd.run_step(self._step, steps)

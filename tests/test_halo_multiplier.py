@@ -36,7 +36,7 @@ def test_jacobi_multiplier_matches_plain(mult, overlap):
 
     macro = 2
     plain.step(macro * mult)
-    fat.step(macro)  # each built step advances mult iterations
+    fat.step(macro * mult)  # step() counts RAW iterations on every engine
     np.testing.assert_allclose(plain.temperature(), fat.temperature(), rtol=1e-6)
 
 
@@ -48,7 +48,7 @@ def test_jacobi_multiplier_uneven():
     fat.dd.set_halo_multiplier(2)
     fat.realize()
     plain.step(4)
-    fat.step(2)
+    fat.step(4)
     np.testing.assert_allclose(plain.temperature(), fat.temperature(), rtol=1e-6)
 
 
@@ -60,7 +60,7 @@ def test_astaroth_multiplier_radius3():
     fat.dd.set_halo_multiplier(2)
     fat.realize()
     plain.step(2)
-    fat.step(1)
+    fat.step(2)
     np.testing.assert_allclose(plain.field(), fat.field(), rtol=1e-5, atol=1e-6)
 
 

@@ -7,6 +7,8 @@ import time
 
 import jax
 
+from stencil_tpu.utils.compat import shard_map
+
 from stencil_tpu.utils.config import MethodFlags, PlacementStrategy
 
 
@@ -154,7 +156,7 @@ def make_edge_transfer(mesh, n_dev: int, src: int, dst: int, n_elems: int):
         def f(blk):
             return lax.ppermute(blk, "d", [(src, dst)])
 
-        return jax.shard_map(f, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x)
+        return shard_map(f, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x)
 
     x = jax.device_put(jnp.ones((n_elems * n_dev,), jnp.float32), sharding)
     return go, x
@@ -222,7 +224,7 @@ def make_matrix_transfer(mesh, comm):
                     )
             return tuple(outs)
 
-        return jax.shard_map(
+        return shard_map(
             f,
             mesh=mesh,
             in_specs=tuple(P("d") for _ in arrs),

@@ -18,6 +18,8 @@ import sys
 import time
 
 import jax
+
+from stencil_tpu.utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -39,7 +41,7 @@ def pingpong_times(devices, min_n: int, max_n: int, n_iters: int):
                 fwd = lax.ppermute(blk, "d", [(src, dst)])
                 return lax.ppermute(fwd, "d", [(dst, src)])
 
-            return jax.shard_map(f, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x)
+            return shard_map(f, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x)
 
         x = jax.device_put(jnp.zeros((n_elems * n_dev,), jnp.float32), sharding)
         return rt, x

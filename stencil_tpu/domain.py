@@ -45,6 +45,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from stencil_tpu.core.dim3 import Dim3, Rect3
+from stencil_tpu.utils.compat import shard_map
 from stencil_tpu.core.geometry import LocalSpec
 from stencil_tpu.core.radius import Radius
 from stencil_tpu.ops.exchange import (
@@ -182,6 +183,23 @@ class DistributedDomain:
         # like the reference's barrier-per-call EXCHANGE_STATS (default OFF,
         # CMakeLists.txt:20); opt in via env or enable_exchange_stats().
         self._exchange_stats = os.environ.get("STENCIL_EXCHANGE_STATS", "0") == "1"
+        # resilience: divergence sentinel (off unless STENCIL_DIVERGENCE_EVERY
+        # or set_divergence_check sets a cadence) + dispatch retry policy,
+        # both lazily built on first run_step
+        from stencil_tpu.utils.config import env_int
+
+        self._divergence_every = env_int("STENCIL_DIVERGENCE_EVERY", 0, minimum=0)
+        self._sentinel = None
+        self._retry_policy = None
+
+    def set_divergence_check(self, every: int) -> None:
+        """Enable the divergence sentinel (resilience/sentinel.py): every
+        ``every`` raw steps run through ``run_step``, each floating quantity
+        is checked for NaN/Inf and a classified ``DIVERGENCE`` error names
+        the quantity and step window.  0 disables (the default; the check
+        costs a host readback per quantity per cadence crossing)."""
+        self._divergence_every = int(every)
+        self._sentinel = None  # rebuild with the new cadence
 
     # --- configuration (stencil.hpp:276-306) ---------------------------------
     def set_radius(self, radius) -> None:
@@ -542,7 +560,7 @@ class DistributedDomain:
 
         spec = _qspec(h)
         out = jax.jit(
-            jax.shard_map(per_shard, mesh=self.mesh, in_specs=(spec,), out_specs=spec)
+            shard_map(per_shard, mesh=self.mesh, in_specs=(spec,), out_specs=spec)
         )(self._curr[h.name])
         self._curr[h.name] = out
 
@@ -868,7 +886,7 @@ class DistributedDomain:
 
         @partial(jax.jit, static_argnums=1, **donate_kw)
         def step(curr: Dict[str, jax.Array], steps: int = 1) -> Dict[str, jax.Array]:
-            fn = jax.shard_map(
+            fn = shard_map(
                 partial(per_shard, steps),
                 mesh=self.mesh,
                 in_specs=specs,
@@ -878,9 +896,13 @@ class DistributedDomain:
             outs = fn(*[curr[k] for k in names])
             return dict(zip(names, outs))
 
+        # under a halo multiplier each built step is a MACRO step advancing
+        # `mult` raw iterations — consumers that count raw steps (the
+        # divergence sentinel) read this factor off the step
+        step._raw_steps_per_call = mult
         return step
 
-    def run_step(self, step_fn, steps: int = 1) -> None:
+    def run_step(self, step_fn, steps: int = 1, label: str = None) -> None:
         """Apply a built step to curr and make its output the new curr.
 
         The built step already fuses the buffer rotation: with donation the
@@ -891,9 +913,46 @@ class DistributedDomain:
         ``steps > 1`` runs that many iterations in ONE device dispatch
         (``lax.fori_loop`` inside the shard_map) — essential on TPU, where
         per-dispatch overhead would otherwise dominate small steps.
+
+        This is the resilience layer's DISPATCH boundary (one entry for
+        every engine — xla, stream, and the bespoke pallas paths):
+
+        * classified ``TRANSIENT_RUNTIME`` failures (the remote-compile
+          tunnel class) retry with exponential backoff — guarded by a
+          donated-buffer liveness check, so a failure that surfaced AFTER
+          donation propagates instead of re-reading freed memory;
+        * the ``STENCIL_FAULT_PLAN`` hook fires here with phase
+          ``dispatch`` and this call's ``label`` (models pass their name);
+        * the divergence sentinel (``set_divergence_check``) runs on its
+          cadence after a successful dispatch.
         """
-        self._curr = step_fn(self._curr, steps)
+        from stencil_tpu.resilience import inject
+        from stencil_tpu.resilience.retry import RetryPolicy, execute_with_retry
+        from stencil_tpu.resilience.sentinel import DivergenceSentinel
+
+        if label is None:
+            label = getattr(step_fn, "_resilience_label", "step")
+        if self._retry_policy is None:
+            self._retry_policy = RetryPolicy.from_env()
+
+        def dispatch():
+            inject.maybe_fail("dispatch", label)
+            return step_fn(self._curr, steps)
+
+        self._curr = execute_with_retry(
+            dispatch,
+            label=f"dispatch:{label}",
+            policy=self._retry_policy,
+            buffers=lambda: self._curr,
+        )
         # streaming-engine steps advance interiors only; the carried shell
         # goes stale and raw readback must re-exchange first
         if getattr(step_fn, "_marks_shell_stale", False):
             self.mark_shell_stale()
+        if self._sentinel is None or self._sentinel.every != self._divergence_every:
+            self._sentinel = DivergenceSentinel(self._divergence_every)
+        # sentinel cadence and the reported step index are in RAW iterations:
+        # a macro step (halo multiplier on the xla engine) advances `mult`
+        # raw iterations per dispatch-step, which the built step declares
+        raw = steps * getattr(step_fn, "_raw_steps_per_call", 1)
+        self._sentinel.after_steps(self, raw)

@@ -63,6 +63,8 @@ class AstarothSim:
         # viable) | "per-step" (reference parity escape hatch: exchange
         # every iteration, modeling Astaroth's real communication volume —
         # astaroth_sim.cu:223-274)
+        check_divergence_every: int = 0,  # divergence sentinel cadence
+        # (resilience/sentinel.py); 0 = off
     ):
         self.dd = DistributedDomain(x, y, z)
         self.dd.set_radius(Radius.constant(3))  # astaroth_sim.cu:184
@@ -79,6 +81,8 @@ class AstarothSim:
         if schedule not in ("auto", "per-step", "wavefront"):
             raise ValueError(f"unknown schedule {schedule!r}")
         self.schedule = schedule
+        if check_divergence_every:
+            self.dd.set_divergence_check(check_divergence_every)
         self._step = None
 
     def realize(self) -> None:
@@ -162,7 +166,9 @@ class AstarothSim:
                     f"multiplier {mult} on the jnp engine (macro steps)"
                 )
             steps //= mult
-        self.dd.run_step(self._step, steps)
+        # label routes dispatch-phase fault injection / retry logs to THIS
+        # model (the stream engine's own ladder hooks stay labeled stream:*)
+        self.dd.run_step(self._step, steps, label="astaroth")
 
     def field(self, i: int = 0) -> np.ndarray:
         return self.dd.quantity_to_host(self.handles[i])

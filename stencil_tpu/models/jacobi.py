@@ -19,6 +19,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from stencil_tpu.utils.compat import shard_map
+
 from stencil_tpu.core.dim3 import Dim3
 from stencil_tpu.core.radius import Radius
 from stencil_tpu.domain import DistributedDomain
@@ -43,6 +45,8 @@ class Jacobi3D:
         interpret: bool = False,  # pallas interpreter mode (CPU testing)
         temporal_k="auto",  # wrap-path temporal blocking depth (int | "auto")
         pallas_path: str = "auto",  # "auto"|"wrap"|"slab"|"shell"|"wavefront"
+        check_divergence_every: int = 0,  # divergence sentinel cadence
+        # (resilience/sentinel.py); 0 = off
     ):
         self.dd = DistributedDomain(x, y, z)
         # radius 1 on faces only (jacobi3d.cu:205-214)
@@ -61,7 +65,10 @@ class Jacobi3D:
         if pallas_path not in ("auto", "wrap", "slab", "shell", "wavefront"):
             raise ValueError(f"unknown pallas_path {pallas_path!r}")
         self.pallas_path_request = pallas_path
+        if check_divergence_every:
+            self.dd.set_divergence_check(check_divergence_every)
         self._step = None
+        self._ladder = None  # degradation ladder, built at realize()
         # fast paths (wrap/slab kernels) advance interiors only; the carried
         # shell goes stale and raw readback must re-exchange (mark_shell_stale)
         self._marks_shell_stale = False
@@ -105,6 +112,7 @@ class Jacobi3D:
                 self._step = self._make_pallas_step()
         else:
             self._step = self.dd.make_step(self._kernel, overlap=self.overlap)
+        self._ladder = self._make_ladder()
 
     def _planned_devices(self) -> int:
         import jax
@@ -281,16 +289,28 @@ class Jacobi3D:
         Zp = lane_pad_width(Zr) if z_slab_mode else Zr
 
         def per_shard(steps, raw_block):
-            origin = jnp.stack(
-                [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
-            )
-            yz_d2 = pack_d2(
-                yz_dist2_plane(origin[1] - m, origin[2] - m, (raw.y, Zp), gsize),
-                gsize,
-            )
+            # origin (and everything derived from it, like the d2 planes)
+            # must be computed INSIDE each loop body: axis_index lowers to
+            # partition-id, which XLA's SPMD partitioner rejects as a
+            # while-loop operand on some toolchains (see ops/stream.py
+            # origin_of; LICM re-hoists it after partitioning)
+            def origin_of():
+                return jnp.stack(
+                    [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
+                )
+
+            def d2_of(origin):
+                return pack_d2(
+                    yz_dist2_plane(
+                        origin[1] - m, origin[2] - m, (raw.y, Zp), gsize
+                    ),
+                    gsize,
+                )
 
             if not z_slab_mode:
                 def macro_plain(depth, b):
+                    origin = origin_of()
+                    yz_d2 = d2_of(origin)
                     b = halo_exchange_shard(
                         b, shell, mesh_shape, valid_last=dd._valid_last
                     )
@@ -314,12 +334,15 @@ class Jacobi3D:
             if z_ring_mode:
                 # z-interior-only HBM layout + ring-layout working planes
                 Zi = n.z
-                ring_d2 = pack_d2(
-                    zring_dist2_plane(origin[1] - m, origin[2], m, Yr, Zi, gsize),
-                    gsize,
-                )
 
                 def macro_ring(depth, carry):
+                    origin = origin_of()
+                    ring_d2 = pack_d2(
+                        zring_dist2_plane(
+                            origin[1] - m, origin[2], m, Yr, Zi, gsize
+                        ),
+                        gsize,
+                    )
                     b, zout = carry
                     b = halo_exchange_shard(b, shell, mesh_shape, axes=(0, 1))
                     zs = permute_and_extend_z_slabs(zout, m, mesh_shape, yext, xext)
@@ -345,6 +368,8 @@ class Jacobi3D:
                 return jnp.pad(carry[0], ((0, 0), (0, 0), (m, m)))
 
             def macro(depth, carry):
+                origin = origin_of()
+                yz_d2 = d2_of(origin)
                 b, zout = carry
                 # x/y shells in the array (cheap: planes / sublane rows)
                 b = halo_exchange_shard(b, shell, mesh_shape, axes=(0, 1))
@@ -372,7 +397,7 @@ class Jacobi3D:
         @partial(jax.jit, static_argnums=1, donate_argnums=0)
         def step(curr, steps: int = 1):
             # check_vma off: pallas_call outputs carry no vma annotation
-            fn = jax.shard_map(
+            fn = shard_map(
                 partial(per_shard, steps),
                 mesh=dd.mesh,
                 in_specs=(spec,),
@@ -500,13 +525,15 @@ class Jacobi3D:
         name = self.h.name
 
         def per_shard(steps, block):
-            origin = jnp.stack(
-                [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
-            )
             shape_yz = (block.shape[1] - 2, block.shape[2] - 2)
-            yz_d2 = yz_dist2_plane(origin[1], origin[2], shape_yz, gsize)
 
             def body(_, b):
+                # inside the loop body: axis_index as a while operand trips
+                # the SPMD partitioner on some toolchains (see ops/stream.py)
+                origin = jnp.stack(
+                    [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
+                )
+                yz_d2 = yz_dist2_plane(origin[1], origin[2], shape_yz, gsize)
                 b = halo_exchange_shard(b, shell, mesh_shape, valid_last=valid_last)
                 return jacobi_plane_step(b, origin, yz_d2, gsize, interpret=interpret)
 
@@ -517,7 +544,7 @@ class Jacobi3D:
         @partial(jax.jit, static_argnums=1, donate_argnums=0)
         def step(curr, steps: int = 1):
             # check_vma off: pallas_call out_shape carries no vma annotation
-            fn = jax.shard_map(
+            fn = shard_map(
                 partial(per_shard, steps),
                 mesh=dd.mesh,
                 in_specs=(spec,),
@@ -559,15 +586,17 @@ class Jacobi3D:
         self._pallas_path = "slab"
 
         def per_shard(steps, raw_block):
-            origin = jnp.stack(
-                [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
-            )
-            yz_d2 = yz_dist2_plane(origin[1], origin[2], (n.y, n.z), gsize)
             block = lax.slice(
                 raw_block, (lo.x, lo.y, lo.z), (lo.x + n.x, lo.y + n.y, lo.z + n.z)
             )
 
             def body(_, b):
+                # inside the loop body: axis_index as a while operand trips
+                # the SPMD partitioner on some toolchains (see ops/stream.py)
+                origin = jnp.stack(
+                    [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
+                )
+                yz_d2 = yz_dist2_plane(origin[1], origin[2], (n.y, n.z), gsize)
                 # each slab is the sender's outermost interior plane — the
                 # -dir convention at radius 1 (packer.cuh:91-93); z-slabs
                 # travel transposed so lanes ride the x axis (see
@@ -591,7 +620,7 @@ class Jacobi3D:
         @partial(jax.jit, static_argnums=1, donate_argnums=0)
         def step(curr, steps: int = 1):
             # check_vma off: pallas_call outputs carry no vma annotation
-            fn = jax.shard_map(
+            fn = shard_map(
                 partial(per_shard, steps),
                 mesh=dd.mesh,
                 in_specs=(spec,),
@@ -648,35 +677,64 @@ class Jacobi3D:
                     f"multiplier {mult} on the jnp engine (macro steps)"
                 )
             steps //= mult
-        while True:
-            try:
-                self.dd.run_step(self._step, steps)
-                break
-            except Exception as e:
-                if not self._step_down_on_vmem_oom(e):
-                    raise
+        self._ladder.step(steps)
         if self._marks_shell_stale:
             self.dd.mark_shell_stale()
 
-    def _step_down_on_vmem_oom(self, e: BaseException) -> bool:
+    def _rung_name(self) -> str:
+        if self.kernel_impl != "pallas":
+            return "xla"
+        if self._pallas_path == "wrap":
+            return f"wrap[k={self._wrap_k}]"
+        if self._pallas_path == "wavefront":
+            depth = getattr(self, "_wavefront_depth", self._wavefront_m)
+            return f"wavefront[depth={depth}]"
+        return self._pallas_path or "pallas"
+
+    def _run_current(self, steps: int = 1) -> None:
+        # resolves self._step at CALL time: the degradation ladder swaps the
+        # built step underneath when a rung steps down
+        self.dd.run_step(self._step, steps, label="jacobi")
+
+    def _make_ladder(self):
+        """The model's degradation ladder (resilience/ladder.py): wrap
+        re-plans at k-1 per descent, the wavefront keeps its allocated
+        m-wide shell and advances fewer levels per pass — the same implicit
+        order the old hand-rolled try/except walked, now with classified
+        failures, donation-guarded re-invocation, and fault-injection hooks
+        labeled ``jacobi:<rung>``."""
+        from stencil_tpu.resilience.ladder import DegradationLadder, Rung
+
+        def rung():
+            return Rung(name=self._rung_name(), build=lambda: self._run_current)
+
+        def lower(rung_, cls, exc):
+            return rung() if self._step_down(cls) else None
+
+        return DegradationLadder(
+            rung(), lower=lower, label="jacobi", buffers=lambda: self.dd._curr
+        )
+
+    def _step_down(self, cls) -> bool:
         """Runtime fallback for the bespoke pallas paths: when Mosaic
-        rejects the planned temporal depth (scoped-VMEM OOM — the calibrated
-        model under-estimated on this toolchain), rebuild one level
-        shallower instead of crashing.  The wavefront keeps its allocated
-        m-wide shell and just advances fewer levels per pass
-        (``_wavefront_depth``); the wrap path re-plans with ``temporal_k-1``.
-        Returns True when a shallower rebuild was installed."""
-        from stencil_tpu.ops.stream import _is_vmem_oom
+        rejects the planned temporal depth (scoped-VMEM OOM or another
+        classified compile reject — the calibrated model under-estimated on
+        this toolchain), rebuild one level shallower instead of crashing.
+        The wavefront keeps its allocated m-wide shell and just advances
+        fewer levels per pass (``_wavefront_depth``); the wrap path re-plans
+        with ``temporal_k-1``.  Returns True when a shallower rebuild was
+        installed."""
         from stencil_tpu.utils.logging import log_warn
 
-        if not _is_vmem_oom(e) or self.kernel_impl != "pallas":
+        if self.kernel_impl != "pallas":
             return False
         if self._pallas_path == "wrap" and self._wrap_k > 1:
             self.temporal_k = self._wrap_k - 1
             log_warn(
                 f"wrap temporal depth k={self._wrap_k} exceeded the compiler's "
-                f"scoped-VMEM budget; retrying k={self.temporal_k} (recalibrate "
-                "the VMEM model / STENCIL_VMEM_LIMIT_BYTES for this toolchain)"
+                f"capability ({cls.value}); retrying k={self.temporal_k} "
+                "(for vmem_oom: recalibrate the VMEM model / "
+                "STENCIL_VMEM_LIMIT_BYTES for this toolchain)"
             )
             self._step = self._make_pallas_step()
             return True
@@ -686,9 +744,10 @@ class Jacobi3D:
                 return False
             self._wavefront_depth = depth - 1
             log_warn(
-                f"wavefront depth {depth} exceeded the compiler's scoped-VMEM "
-                f"budget; retrying depth {depth - 1} over the same {self._wavefront_m}"
-                "-wide shell (recalibrate the VMEM model for this toolchain)"
+                f"wavefront depth {depth} exceeded the compiler's capability "
+                f"({cls.value}); retrying depth {depth - 1} over the same "
+                f"{self._wavefront_m}-wide shell (for vmem_oom: recalibrate "
+                "the VMEM model for this toolchain)"
             )
             self._step = self._make_wavefront_step()
             return True

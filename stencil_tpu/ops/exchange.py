@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.utils.compat import shard_map
 from stencil_tpu.core.radius import Radius
 from stencil_tpu.parallel.mesh import MESH_AXES
 
@@ -387,7 +388,7 @@ def make_exchange_fn(
         max_extra = max(
             [ndim_extra] + [l.ndim - 3 for l in leaves], default=ndim_extra
         )
-        shard_fn = jax.shard_map(
+        shard_fn = shard_map(
             per_shard,
             mesh=mesh,
             in_specs=tuple(leaf_spec(l) for l in leaves),

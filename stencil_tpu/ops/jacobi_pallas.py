@@ -26,7 +26,6 @@ selects.
 
 from __future__ import annotations
 
-import os
 from typing import Tuple
 
 import jax
@@ -69,10 +68,30 @@ _VMEM_BUDGET_DEFAULT = 100 * 1024 * 1024
 _VMEM_STACK_MARGIN = 3_000_000
 
 
+_vmem_warned: set = set()
+
+
 def _vmem_budget() -> int:
     """Requested scoped-VMEM bytes; ``STENCIL_VMEM_LIMIT_BYTES`` overrides
-    (read per call so tests can force an over-budget compile)."""
-    return int(os.environ.get("STENCIL_VMEM_LIMIT_BYTES", _VMEM_BUDGET_DEFAULT))
+    (read per call so tests can force an over-budget compile).  The read is
+    VALIDATED (``utils.config.env_int``): a malformed value raises a message
+    naming the env var instead of a bare ``ValueError`` deep inside
+    planning, a zero/negative value (which would silently disable every
+    streaming route) is rejected, and a value under Mosaic's 16 MB default
+    warns once per distinct value."""
+    from stencil_tpu.utils.config import env_int
+
+    val = env_int("STENCIL_VMEM_LIMIT_BYTES", _VMEM_BUDGET_DEFAULT, minimum=1)
+    if val < 16 * 1024 * 1024 and val not in _vmem_warned:
+        _vmem_warned.add(val)
+        from stencil_tpu.utils.logging import log_warn
+
+        log_warn(
+            f"STENCIL_VMEM_LIMIT_BYTES={val} is below Mosaic's 16 MB default "
+            "scoped-VMEM budget; deep streaming routes will degrade to "
+            "shallow/plane rungs"
+        )
+    return val
 
 #: deepest depth validated on hardware and the measured plateau: probe20b/c/d
 #: (512^3, 100 MB budget) k=8 128-132, k=12 190, k=16 142-202, k=20 190,
@@ -86,10 +105,10 @@ def _tpu_compiler_params(interpret: bool):
     interpret mode (no Mosaic, nothing to budget)."""
     if interpret:
         return {}
-    from jax.experimental.pallas import tpu as pltpu
+    from stencil_tpu.utils.compat import tpu_compiler_params
 
     return {
-        "compiler_params": pltpu.CompilerParams(vmem_limit_bytes=_vmem_budget())
+        "compiler_params": tpu_compiler_params(vmem_limit_bytes=_vmem_budget())
     }
 
 

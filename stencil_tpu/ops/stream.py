@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.utils.compat import shard_map
 from stencil_tpu.ops.jacobi_pallas import (
     _make_roll,
     _padded_plane_bytes,
@@ -660,11 +661,6 @@ def permute_and_extend_z_slabs(zout, s: int, mesh_shape, yext, xext):
     return jnp.concatenate([xext(yext(zlo)), xext(yext(zhi))], axis=1)
 
 
-def _is_vmem_oom(exc: BaseException) -> bool:
-    msg = str(exc).lower()
-    return "vmem" in msg and ("ran out of memory" in msg or "exceeded" in msg)
-
-
 def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
     from jax.sharding import PartitionSpec as P
 
@@ -701,6 +697,12 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
     alias = len(names) >= 4 if _alias_env == "auto" else _alias_env == "1"
 
     def origin_of():
+        # NOTE: must be called INSIDE the fori_loop body that consumes it.
+        # axis_index lowers to partition-id; a while-loop OPERAND whose def
+        # chain includes partition-id trips XLA's SPMD partitioner
+        # ("PartitionId instruction is not supported for SPMD partitioning")
+        # on some toolchains, while the same op inside the body partitions
+        # fine (and LICM hoists it after partitioning anyway).
         return jnp.stack(
             [lax.axis_index(MESH_AXES[ax]) * n[ax] for ax in range(3)]
         )
@@ -709,13 +711,13 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
         k = plan["m"]
 
         def per_shard(steps, *blocks_raw):
-            origin = origin_of()
             bs = tuple(
                 lax.slice(b, (lo.x, lo.y, lo.z), (lo.x + n.x, lo.y + n.y, lo.z + n.z))
                 for b in blocks_raw
             )
 
             def one(depth, bs):
+                origin = origin_of()
                 out = list(bs)
                 for g in groups:
                     outs = stream_wrap_pass(
@@ -738,9 +740,8 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
     elif plan["route"] == "plane":
 
         def per_shard(steps, *blocks):
-            origin = origin_of()
-
             def body(_, bs):
+                origin = origin_of()
                 bs = list(
                     halo_exchange_multi(bs, shell, mesh_shape, valid_last=valid_last)
                 )
@@ -784,11 +785,10 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
             return outs, zouts
 
         def per_shard(steps, *blocks):
-            origin = origin_of()
-
             if not z_slab_mode:
 
                 def macro(depth, bs):
+                    origin = origin_of()
                     bs = list(
                         halo_exchange_multi(
                             bs, shell, mesh_shape, valid_last=valid_last
@@ -804,6 +804,7 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
                 return bs
 
             def macro(depth, carry):
+                origin = origin_of()
                 bs, zouts = carry
                 bs = list(
                     halo_exchange_multi(bs, shell, mesh_shape, axes=(0, 1))
@@ -833,7 +834,7 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
     @partial(jax.jit, static_argnums=1, **donate_kw)
     def step(curr, steps: int = 1):
         # check_vma off: pallas_call outputs carry no vma annotation
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(per_shard, steps),
             mesh=dd.mesh,
             in_specs=tuple(spec for _ in names),
@@ -875,11 +876,16 @@ def make_stream_step(
     COMPUTE-heavy kernel (e.g. 27 taps/cell) multiplies its VPU work by the
     depth with nothing to amortize; cap it low (2-4) for such kernels.
 
-    The returned step carries a RUNTIME fallback: if Mosaic rejects the
-    planned wavefront depth (scoped-VMEM OOM — the model under-estimated on
-    this toolchain), the step rebuilds one level shallower and retries,
-    logging a recalibration hint, until the plane route is reached.  The
-    current plan is exposed as ``step._stream_plan``.
+    The returned step rides the resilience DEGRADATION LADDER
+    (``resilience/ladder.py``): if Mosaic rejects the planned wavefront depth
+    (scoped-VMEM OOM, or any other classified compile reject), the ladder
+    re-plans one level shallower and retries, logging a recalibration hint,
+    until the plane route is reached — at which point the failure propagates.
+    Re-invocation is donation-guarded (a deleted input buffer refuses the
+    descent), and fault-injection hooks labeled ``stream:<rung>`` fire at
+    build and execute time (``STENCIL_FAULT_PLAN``).  The current plan is
+    exposed as ``step._stream_plan``; the descent history as
+    ``step._resilience.descents``.
     """
     if max_depth is not None:
         import operator
@@ -897,40 +903,46 @@ def make_stream_step(
                 f"stream_depth must be >= 1, got {max_depth} (a 0/negative "
                 "cap would silently disable temporal blocking)"
             )
+    from stencil_tpu.resilience.ladder import DegradationLadder, Rung
+
     plan = plan_stream(dd, x_radius, path, separable, max_m=max_depth)
-    state = {
-        "plan": plan,
-        "impl": _build_stream_step(dd, kernel, x_radius, plan, interpret, donate),
-    }
+
+    def rung_for(p):
+        # build() resolves _build_stream_step through module globals at call
+        # time, so tests may monkeypatch it
+        return Rung(
+            name=f"{p['route']}[m={p['m']}]",
+            build=lambda: _build_stream_step(dd, kernel, x_radius, p, interpret, donate),
+            state={"plan": p},
+        )
+
+    def lower(rung, cls, exc):
+        plan_now = rung.state["plan"]
+        if plan_now["route"] not in ("wavefront", "wrap") or plan_now["m"] <= 1:
+            return None  # plane route is the bottom rung — propagate
+        from stencil_tpu.utils.logging import log_warn
+
+        new_max = plan_now["m"] - 1
+        log_warn(
+            f"{plan_now['route']} depth m={plan_now['m']} exceeded the "
+            f"compiler's capability ({cls.value}) at runtime; stepping down to "
+            f"m<={new_max} (the VMEM model under-estimates on this "
+            "toolchain — consider recalibrating _VMEM_STACK_MARGIN / "
+            "STENCIL_VMEM_LIMIT_BYTES)"
+        )
+        return rung_for(plan_stream(dd, x_radius, path, separable, max_m=new_max))
+
+    ladder = DegradationLadder(rung_for(plan), lower=lower, label="stream")
 
     def step(curr, steps: int = 1):
-        while True:
-            try:
-                return state["impl"](curr, steps)
-            except Exception as e:  # jax wraps Mosaic failures variously
-                plan_now = state["plan"]
-                if not (
-                    _is_vmem_oom(e)
-                    and plan_now["route"] in ("wavefront", "wrap")
-                    and plan_now["m"] > 1
-                ):
-                    raise
-                from stencil_tpu.utils.logging import log_warn
-
-                new_max = plan_now["m"] - 1
-                log_warn(
-                    f"{plan_now['route']} depth m={plan_now['m']} exceeded the "
-                    f"compiler's scoped-VMEM budget at runtime; stepping down to "
-                    f"m<={new_max} (the VMEM model under-estimates on this "
-                    "toolchain — consider recalibrating _VMEM_STACK_MARGIN / "
-                    "STENCIL_VMEM_LIMIT_BYTES)"
-                )
-                state["plan"] = plan_stream(dd, x_radius, path, separable, max_m=new_max)
-                state["impl"] = _build_stream_step(
-                    dd, kernel, x_radius, state["plan"], interpret, donate
-                )
-                step._stream_plan = state["plan"]
+        out = ladder.step(curr, steps)
+        step._stream_plan = ladder.rung.state["plan"]
+        return out
 
     step._marks_shell_stale = True
-    step._stream_plan = plan
+    # the eager build may already have descended (compile-phase rejection),
+    # so expose the LADDER's plan, not the initial one
+    step._stream_plan = ladder.rung.state["plan"]
+    step._resilience = ladder
+    step._resilience_label = "stream"
     return step

@@ -53,26 +53,36 @@ def axis_edge_kinds(mesh) -> List[str]:
     including the periodic wrap edge — crosses a process boundary (the
     collective's critical hop rides the slowest link), "ici" otherwise.
     A node-major axis mixing intra- and inter-host hops is therefore
-    priced at DCN speed."""
+    priced at DCN speed.
+
+    EVERY line along the axis is scanned (all index combinations of the
+    other axes), not just the lead line: a mesh whose process boundaries are
+    not axis-aligned planes (e.g. a snaking device order) would otherwise be
+    misclassified as ici and under-project the cost in ``write_plan``.
+    Device counts are small, so the exhaustive scan is cheap."""
+    import itertools
+
     import numpy as np
 
     devs = np.asarray(mesh.devices)
+    proc = np.vectorize(lambda d: getattr(d, "process_index", 0))(devs)
     kinds = []
     for ax in range(devs.ndim):
         size = devs.shape[ax]
         if size == 1:
             kinds.append("self")
             continue
-        lead = [0] * devs.ndim
+        other_dims = [range(devs.shape[b]) for b in range(devs.ndim) if b != ax]
         kind = "ici"
-        for j in range(size):
-            a_idx, b_idx = list(lead), list(lead)
-            a_idx[ax] = j
-            b_idx[ax] = (j + 1) % size
-            pa = getattr(devs[tuple(a_idx)], "process_index", 0)
-            pb = getattr(devs[tuple(b_idx)], "process_index", 0)
-            if pa != pb:
-                kind = "dcn"
+        for rest in itertools.product(*other_dims):
+            for j in range(size):
+                a_idx = list(rest[:ax]) + [j] + list(rest[ax:])
+                b_idx = list(a_idx)
+                b_idx[ax] = (j + 1) % size
+                if proc[tuple(a_idx)] != proc[tuple(b_idx)]:
+                    kind = "dcn"
+                    break
+            if kind == "dcn":
                 break
         kinds.append(kind)
     return kinds

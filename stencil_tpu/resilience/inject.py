@@ -1,0 +1,208 @@
+"""Deterministic fault injection: make every resilience path testable on CPU.
+
+``STENCIL_FAULT_PLAN`` holds a comma-separated list of fault entries:
+
+    entry := phase ':' class [':' label-glob] ['*' count]
+    phase := compile | execute | dispatch | any
+    class := vmem_oom | compile_reject | transient | divergence | fatal
+
+Each entry fires ``count`` times (default 1) at matching hook sites, then is
+spent.  Phases map to the three hook sites:
+
+* ``compile``  — inside ``DegradationLadder`` when a rung's step impl is
+  (re)built: models a compiler rejection before any execution.
+* ``execute``  — inside ``DegradationLadder`` immediately before the rung's
+  impl runs: models a runtime failure of the compiled step.
+* ``dispatch`` — inside ``DistributedDomain.run_step`` before the step
+  function is invoked: models infrastructure failures (the remote-compile
+  tunnel class) that strike any engine, including the plain XLA route.
+
+The optional label targets a specific site.  It matches when the hook label
+starts with the pattern LITERALLY (so an exact rung label like
+``stream:wavefront[m=3]`` works even though it contains characters fnmatch
+treats specially), or when the pattern matches as an ``fnmatch`` glob with
+an implicit trailing ``*`` (only a TRAILING ``*<digits>`` is the count
+suffix; a ``*`` elsewhere belongs to the glob).  Ladder hooks are labeled
+``<engine>:<rung>`` (e.g. ``stream:wavefront[m=3]``, ``jacobi:wrap[k=8]``),
+dispatch hooks carry the label passed to ``run_step`` (models pass their
+name: ``jacobi``, ``astaroth``).  Examples:
+
+    STENCIL_FAULT_PLAN='execute:vmem_oom:stream*2'
+        -> the stream engine's next two step executions raise a
+           Mosaic-worded scoped-VMEM OOM (driving the ladder down 2 rungs)
+    STENCIL_FAULT_PLAN='dispatch:transient:astaroth*9'
+        -> every astaroth dispatch fails with a tunnel-style transient error
+           until the 9 charges are spent (outlasting the retry budget)
+
+Injected VMEM_OOM / COMPILE_REJECT / TRANSIENT faults are raised as
+``InjectedFault`` with the SAME message wording the real toolchain emits, so
+they flow through ``classify()``'s substring matching exactly like the real
+thing; DIVERGENCE raises a typed ``DivergenceError``.
+
+The plan is parsed lazily from the environment on first use and re-parsed
+whenever the env var's value changes (so tests can monkeypatch it without an
+explicit reset); ``set_plan`` installs a plan programmatically, bypassing the
+environment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+import re
+from typing import List, Optional
+
+from stencil_tpu.resilience.taxonomy import (
+    DivergenceError,
+    FailureClass,
+    InjectedFault,
+)
+
+ENV_VAR = "STENCIL_FAULT_PLAN"
+
+_PHASES = ("compile", "execute", "dispatch", "any")
+_CLASSES = {
+    "vmem_oom": FailureClass.VMEM_OOM,
+    "compile_reject": FailureClass.COMPILE_REJECT,
+    "transient": FailureClass.TRANSIENT_RUNTIME,
+    "divergence": FailureClass.DIVERGENCE,
+    "fatal": FailureClass.FATAL,
+}
+
+#: The message each injected class carries — the REAL toolchain wording (the
+#: same texts ``taxonomy`` pins), tagged with the injection site.
+_MESSAGES = {
+    FailureClass.VMEM_OOM: (
+        "Ran out of memory in memory space vmem: exceeded scoped vmem "
+        "limit by 8.59M"
+    ),
+    FailureClass.COMPILE_REJECT: (
+        "Mosaic failed to compile TPU kernel: unsupported unaligned shape"
+    ),
+    FailureClass.TRANSIENT_RUNTIME: (
+        "UNAVAILABLE: connection reset by peer (remote compile tunnel)"
+    ),
+    FailureClass.FATAL: "injected fatal failure",
+}
+
+
+@dataclasses.dataclass
+class _Entry:
+    phase: str
+    cls: FailureClass
+    label_glob: str
+    remaining: int
+
+
+def _parse_entry(text: str) -> _Entry:
+    text = text.strip()
+    count = 1
+    # the count suffix is ONLY a trailing '*<digits>' — a '*' elsewhere is
+    # part of the label glob (e.g. 'execute:vmem_oom:*wavefront*3')
+    m = re.match(r"^(.*)\*(\d+)$", text)
+    if m:
+        text, count = m.group(1), int(m.group(2))
+        if count < 1:
+            raise ValueError(f"{ENV_VAR}: count must be >= 1, got {count}")
+    # split at most twice: ladder labels themselves contain colons
+    # ("stream:wavefront[m=3]"), so everything after the class is the glob
+    parts = text.split(":", 2)
+    if len(parts) == 2:
+        phase, cls_name = parts
+        label_glob = "*"
+    elif len(parts) == 3:
+        phase, cls_name, label_glob = parts
+    else:
+        raise ValueError(
+            f"{ENV_VAR}: entry {text!r} is not phase:class[:label][*count]"
+        )
+    phase = phase.strip().lower()
+    cls_name = cls_name.strip().lower()
+    if phase not in _PHASES:
+        raise ValueError(
+            f"{ENV_VAR}: unknown phase {phase!r} (one of {', '.join(_PHASES)})"
+        )
+    if cls_name not in _CLASSES:
+        raise ValueError(
+            f"{ENV_VAR}: unknown failure class {cls_name!r} "
+            f"(one of {', '.join(_CLASSES)})"
+        )
+    return _Entry(phase, _CLASSES[cls_name], label_glob.strip() or "*", count)
+
+
+class FaultPlan:
+    """A parsed, stateful fault plan: entries are consumed as they fire."""
+
+    def __init__(self, entries: List[_Entry]):
+        self._entries = entries
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        entries = [_parse_entry(e) for e in text.split(",") if e.strip()]
+        return cls(entries)
+
+    def pending(self) -> int:
+        return sum(e.remaining for e in self._entries)
+
+    def fire(self, phase: str, label: str) -> None:
+        """Raise the first matching entry's fault (consuming one charge)."""
+        for e in self._entries:
+            if e.remaining <= 0:
+                continue
+            if e.phase != "any" and e.phase != phase:
+                continue
+            # PREFIX match first — rung labels contain '[m=3]', which
+            # fnmatch would misread as a one-character class, so an exact
+            # or plain-prefix pattern must match literally; fnmatch globs
+            # (with an implicit trailing '*') cover the wildcard cases
+            if not (
+                label.startswith(e.label_glob)
+                or fnmatch.fnmatchcase(label, e.label_glob)
+                or fnmatch.fnmatchcase(label, e.label_glob + "*")
+            ):
+                continue
+            e.remaining -= 1
+            _raise(e.cls, phase, label)
+
+
+def _raise(cls: FailureClass, phase: str, label: str) -> None:
+    site = f" [fault-injected at {phase}:{label}]"
+    if cls is FailureClass.DIVERGENCE:
+        raise DivergenceError(quantity=f"<injected:{label}>", step=-1)
+    # plain message text: VMEM_OOM / COMPILE_REJECT / TRANSIENT rely on
+    # classify()'s substring matching, exercising the real code path (the
+    # FATAL message matches no marker and classifies FATAL by default)
+    raise InjectedFault(_MESSAGES[cls] + site)
+
+
+# --- module-level plan state ------------------------------------------------
+_state = {"raw": None, "plan": None, "explicit": False}
+
+
+def set_plan(plan: Optional["FaultPlan | str"]) -> None:
+    """Install a plan programmatically (tests), bypassing the environment.
+    ``None`` clears it and resumes reading ``STENCIL_FAULT_PLAN``."""
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    _state["plan"] = plan
+    _state["explicit"] = plan is not None
+    _state["raw"] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    if _state["explicit"]:
+        return _state["plan"]
+    raw = os.environ.get(ENV_VAR)
+    if raw != _state["raw"]:  # env changed (or first read): re-parse
+        _state["raw"] = raw
+        _state["plan"] = FaultPlan.parse(raw) if raw else None
+    return _state["plan"]
+
+
+def maybe_fail(phase: str, label: str = "") -> None:
+    """Hook call: raise the next matching injected fault, if any.  Inert
+    (one dict lookup + string compare) when no plan is configured."""
+    plan = active_plan()
+    if plan is not None:
+        plan.fire(phase, label)

@@ -1,0 +1,152 @@
+"""The degradation ladder: declarative rungs replacing hand-rolled fallback.
+
+The framework's implicit route order — wavefront m=16 -> lower m -> plane
+streaming -> XLA reference — previously lived in three separate try/except
+loops (``make_stream_step``, ``Jacobi3D.step``'s wrap and wavefront cases).
+``DegradationLadder`` centralizes the control flow; each call site supplies
+only its rungs:
+
+* a ``Rung`` names one configuration (e.g. ``wavefront[m=3]``) and knows how
+  to ``build()`` its step impl; arbitrary per-rung state (the stream plan,
+  the bespoke depth) rides ``rung.state``.
+* ``lower(rung, failure_class, exc)`` produces the next rung down (or
+  ``None`` = ladder exhausted, propagate).  Degradable classes are VMEM_OOM
+  and COMPILE_REJECT (``taxonomy.is_degradable``); everything else
+  propagates immediately — transient retry happens at the dispatch layer
+  (``retry.execute_with_retry`` in ``DistributedDomain.run_step``), never
+  here, so the two mechanisms cannot compound.
+
+Re-invoking after a descent re-uses the ORIGINAL call arguments, which is
+only safe while they are alive: compile-rejects surface before donation
+consumes the inputs (the compile-time-only-OOM assumption), and the ladder
+now ENFORCES that with a ``buffers_live`` check — if an input was already
+donated, the original error propagates instead of a use-after-free.
+
+Fault-injection hooks (``inject.maybe_fail``) fire at rung build
+(``compile`` phase) and before each impl invocation (``execute`` phase),
+labeled ``<ladder-label>:<rung-name>`` — so tests drive every rung and every
+descent deterministically on CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+from stencil_tpu.resilience import inject
+from stencil_tpu.resilience.retry import buffers_live
+from stencil_tpu.resilience.taxonomy import FailureClass, classify, is_degradable
+
+
+@dataclasses.dataclass
+class Rung:
+    """One ladder configuration: a name (for logs and fault-plan labels), a
+    zero-arg ``build`` returning the step impl, and free-form state the call
+    site's ``lower`` callback reads to decide the next rung down."""
+
+    name: str
+    build: Callable[[], Callable]
+    state: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class DegradationLadder:
+    """Owns the current rung, its built impl, and classified descent.
+
+    ``step(*args, **kwargs)`` invokes the current rung's impl; on a
+    degradable failure it asks ``lower`` for the next rung, rebuilds, and
+    re-invokes — repeating until an attempt succeeds or the ladder is
+    exhausted.  The descent path is recorded in ``self.descents`` (a list of
+    ``(from_rung, failure_class)`` names) for observability.
+    """
+
+    def __init__(
+        self,
+        first: Rung,
+        lower: Optional[
+            Callable[[Rung, FailureClass, BaseException], Optional[Rung]]
+        ] = None,
+        label: str = "step",
+        eager_build: bool = True,
+        buffers: Optional[Callable[[], Any]] = None,
+    ):
+        self.label = label
+        self.rung = first
+        self._lower = lower
+        # the arrays whose liveness gates a re-invocation; defaults to the
+        # step call's own args (call sites whose donated buffers live
+        # elsewhere — e.g. the models' domain-held curr dict — pass a getter)
+        self._buffers = buffers
+        self._impl: Optional[Callable] = None
+        self.descents = []  # [(rung_name, FailureClass), ...]
+        if eager_build:
+            # a rung whose BUILD is rejected (compile-phase failure) descends
+            # immediately — by construction nothing has executed yet, so no
+            # donation guard is needed here
+            while True:
+                try:
+                    self._ensure_built()
+                    break
+                except Exception as e:
+                    cls = classify(e)
+                    failed = self.rung.name
+                    if not is_degradable(cls) or not self._descend(cls, e):
+                        raise
+                    from stencil_tpu.utils.logging import log_warn
+
+                    log_warn(
+                        f"{self.label}: {cls.value} building rung {failed!r}; "
+                        f"descending to {self.rung.name!r}: {e}"
+                    )
+
+    def _ensure_built(self) -> Callable:
+        if self._impl is None:
+            inject.maybe_fail("compile", f"{self.label}:{self.rung.name}")
+            self._impl = self.rung.build()
+        return self._impl
+
+    def _descend(self, cls: FailureClass, exc: BaseException) -> bool:
+        """Install the next rung down; False when the ladder is exhausted."""
+        if self._lower is None:
+            return False
+        nxt = self._lower(self.rung, cls, exc)
+        if nxt is None:
+            return False
+        self.descents.append((self.rung.name, cls))
+        self.rung = nxt
+        self._impl = None
+        return True
+
+    def step(self, *args, **kwargs):
+        from stencil_tpu.utils.logging import log_warn
+
+        while True:
+            try:
+                impl = self._ensure_built()
+                inject.maybe_fail("execute", f"{self.label}:{self.rung.name}")
+                return impl(*args, **kwargs)
+            except Exception as e:
+                cls = classify(e)
+                if not is_degradable(cls):
+                    raise
+                failed = self.rung.name
+                # a descent re-invokes with the SAME args: refuse BEFORE
+                # descending if any was already donated (deleted) — the
+                # lower() callback has side effects (model mutation, a full
+                # rebuild) that would otherwise be wasted on a re-invocation
+                # the guard then vetoes (see module docstring)
+                candidates = (
+                    self._buffers() if self._buffers is not None else (args, kwargs)
+                )
+                if not buffers_live(candidates):
+                    log_warn(
+                        f"{self.label}: {cls.value} on rung {failed!r} but an "
+                        "input buffer was already donated (deleted) — cannot "
+                        "re-invoke a lower rung, propagating"
+                    )
+                    raise
+                if not self._descend(cls, e):
+                    raise
+                log_warn(
+                    f"{self.label}: {cls.value} on rung {failed!r}; descending "
+                    f"to {self.rung.name!r}: {e}"
+                )

@@ -1,0 +1,116 @@
+"""Retry-with-backoff for TRANSIENT_RUNTIME failures, donation-guarded.
+
+The transient class (remote-compile tunnel drops, RPC unavailability) is the
+one failure mode where re-running the SAME work is the right response — it
+is what discarded an entire bench round's artifact (``BENCH_r05.json``
+rc=1) to a single dropped connection.
+
+The guard: every fast-path step is jitted with ``donate_argnums=0``, so a
+failure that surfaces MID-EXECUTION may have already consumed its input
+buffers — re-invoking would read deleted arrays.  In practice Mosaic
+scoped-VMEM OOM and the tunnel class both surface at COMPILE time, before
+donation (the compile-time-only-OOM assumption, docs/resilience.md), but the
+assumption is now ENFORCED rather than hoped: ``buffers_live`` checks
+``x.is_deleted()`` on every candidate input and a retry is refused (the
+original error propagates, with a logged explanation) when any buffer is
+gone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Iterable, Optional
+
+from stencil_tpu.resilience.taxonomy import FailureClass, classify
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff: attempt n (0-based) sleeps
+    ``backoff_base_s * multiplier**n`` before re-invoking.  ``max_retries=0``
+    disables retrying entirely."""
+
+    max_retries: int = 3
+    backoff_base_s: float = 0.25
+    multiplier: float = 2.0
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        """``STENCIL_RETRY_MAX`` / ``STENCIL_RETRY_BACKOFF_S`` override the
+        defaults (validated reads — see utils/config.py)."""
+        from stencil_tpu.utils.config import env_float, env_int
+
+        return cls(
+            max_retries=env_int("STENCIL_RETRY_MAX", cls.max_retries, minimum=0),
+            backoff_base_s=env_float(
+                "STENCIL_RETRY_BACKOFF_S", cls.backoff_base_s, minimum=0.0
+            ),
+        )
+
+    def delay_s(self, attempt: int) -> float:
+        return self.backoff_base_s * self.multiplier**attempt
+
+
+def buffers_live(buffers) -> bool:
+    """True when no candidate input buffer has been deleted (donated and
+    consumed).  ``buffers`` is any pytree (dict/tuple/list of arrays);
+    non-array leaves (ints, numpy) are trivially live."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(buffers):
+        is_deleted = getattr(leaf, "is_deleted", None)
+        if is_deleted is not None and is_deleted():
+            return False
+    return True
+
+
+def execute_with_retry(
+    fn: Callable,
+    *args,
+    label: str = "step",
+    policy: Optional[RetryPolicy] = None,
+    buffers: Optional[Callable[[], Iterable]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs,
+):
+    """Invoke ``fn(*args, **kwargs)``, retrying classified TRANSIENT_RUNTIME
+    failures with exponential backoff.
+
+    ``buffers`` (a zero-arg callable returning the arrays whose liveness
+    gates a retry) defaults to scanning ``args``/``kwargs`` for jax arrays.
+    Any other failure class propagates immediately — degradation (VMEM_OOM /
+    COMPILE_REJECT) belongs to the ladder, not the retrier.
+    """
+    from stencil_tpu.utils.logging import log_warn
+
+    policy = policy or RetryPolicy.from_env()
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            if classify(e) is not FailureClass.TRANSIENT_RUNTIME:
+                raise
+            if attempt >= policy.max_retries:
+                log_warn(
+                    f"{label}: transient failure persisted through "
+                    f"{policy.max_retries} retries; giving up: {e}"
+                )
+                raise
+            candidates = buffers() if buffers is not None else (args, kwargs)
+            if not buffers_live(candidates):
+                log_warn(
+                    f"{label}: transient failure but an input buffer was "
+                    "already donated (deleted) — retry would reuse freed "
+                    f"memory, propagating instead: {e}"
+                )
+                raise
+            delay = policy.delay_s(attempt)
+            attempt += 1
+            log_warn(
+                f"{label}: transient failure "
+                f"(attempt {attempt}/{policy.max_retries}), retrying in "
+                f"{delay:.2f}s: {e}"
+            )
+            sleep(delay)

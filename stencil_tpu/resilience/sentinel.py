@@ -1,0 +1,53 @@
+"""Divergence sentinel: optional NaN/Inf detection at a step cadence.
+
+A diverged stencil run (unstable step size, corrupted halo, bad forcing)
+keeps consuming accelerator hours producing garbage — and NaN spreads one
+stencil radius per step, so by readback time the whole field is gone with no
+hint of WHEN it broke.  The sentinel trades a configurable amount of
+readback for the first non-finite value's step window and quantity name,
+raised as a classified ``DIVERGENCE`` error (never retried, never degraded:
+re-running the same numerics diverges again).
+
+Off by default.  Enable with ``STENCIL_DIVERGENCE_EVERY=<n>`` (check every n
+raw steps) or programmatically via
+``DistributedDomain.set_divergence_check(n)``; models expose a
+``check_divergence_every`` constructor knob.  The check reads each quantity
+back through ``quantity_to_host`` — which gathers INTERIOR cells only, so
+fast-path kernels' stale/uninitialized shell planes can never
+false-positive (shell bytes are simply never consulted) — and costs a full
+device->host gather per quantity per check: pick a cadence that amortizes
+it (hundreds of steps), or leave it off for benchmarking.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from stencil_tpu.resilience.taxonomy import DivergenceError
+
+
+class DivergenceSentinel:
+    """Tracks cumulative steps and checks all quantities for non-finite
+    values whenever the count crosses a multiple of ``every``."""
+
+    def __init__(self, every: int):
+        if every < 0:
+            raise ValueError(f"divergence check cadence must be >= 0, got {every}")
+        self.every = every
+        self.steps_done = 0
+
+    def after_steps(self, dd, steps: int) -> None:
+        """Account ``steps`` just run on ``dd``; check on cadence crossings.
+        With ``every == 0`` this is pure bookkeeping."""
+        before = self.steps_done
+        self.steps_done += steps
+        if not self.every:
+            return
+        if before // self.every == self.steps_done // self.every:
+            return
+        for h in dd._handles:
+            if not np.issubdtype(np.dtype(h.dtype), np.inexact):
+                continue  # integer fields cannot go non-finite
+            vals = dd.quantity_to_host(h)
+            if not np.isfinite(vals).all():
+                raise DivergenceError(quantity=h.name, step=self.steps_done)

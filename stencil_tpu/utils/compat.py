@@ -1,0 +1,38 @@
+"""jax API compatibility shims — part of the resilience story.
+
+The framework targets the current jax API (``jax.shard_map`` with
+``check_vma``, ``pltpu.CompilerParams``), but deployment containers pin
+older releases where those names live elsewhere (0.4.x:
+``jax.experimental.shard_map.shard_map`` with ``check_rep``,
+``pltpu.TPUCompilerParams``).  Failing with ``AttributeError`` deep inside a
+jitted step is exactly the kind of capability-absence the resilience layer
+exists to avoid, so the lookups degrade here instead: try the current
+spelling, fall back to the old one.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with graceful fallback to the pre-0.5 spelling
+    (``jax.experimental.shard_map.shard_map``), mapping ``check_vma`` onto
+    its old name ``check_rep``."""
+    new = getattr(jax, "shard_map", None)
+    if new is not None:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return new(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as old
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` with fallback to the pre-rename
+    ``pltpu.TPUCompilerParams``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -18,6 +18,41 @@ benchmarking alternatives, exactly the role the reference's flags play:
 from __future__ import annotations
 
 import enum
+import os
+
+
+def _parse_env(name: str, raw: str, conv, kind: str, minimum=None):
+    try:
+        val = conv(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{name}={raw!r} is not a valid {kind} (set a plain {kind} or "
+            f"unset {name})"
+        ) from None
+    if minimum is not None and val < minimum:
+        raise ValueError(
+            f"{name}={raw!r} is below the minimum {minimum} (a too-small "
+            f"value would silently disable the feature {name} tunes)"
+        )
+    return val
+
+
+def env_int(name: str, default: int, minimum: int = None) -> int:
+    """Validated integer env read: a malformed or out-of-range value raises a
+    message NAMING the env var at the read site, instead of a bare
+    ``ValueError`` deep inside planning/compile."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return _parse_env(name, raw, int, "integer", minimum)
+
+
+def env_float(name: str, default: float, minimum: float = None) -> float:
+    """``env_int`` for floats."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return _parse_env(name, raw, float, "number", minimum)
 
 
 class MethodFlags(enum.Flag):

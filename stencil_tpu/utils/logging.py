@@ -40,9 +40,23 @@ def set_level(level) -> None:
 
 
 def _rank() -> int:
+    # ONLY consult jax if a backend is ALREADY initialized: a log line must
+    # never force a backend bring-up (jax.process_index() initializes the
+    # default backend even when jax is merely imported, and on a remote-TPU
+    # container that means a tunnel probe that can hang for minutes — the
+    # TRANSIENT_RUNTIME class of resilience/taxonomy.py, triggered by a
+    # print statement).  Pre-initialization log lines tag rank 0.
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    # FAIL CLOSED: only ask jax for the rank when a backend is verifiably
+    # already up — if the (private) bridge module or its _backends registry
+    # is absent on some jax version, degrade the rank tag to 0 rather than
+    # risk triggering the bring-up
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None or not getattr(xb, "_backends", None):
+        return 0
     try:
-        import jax
-
         return jax.process_index()
     except Exception:
         return 0

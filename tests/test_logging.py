@@ -14,6 +14,9 @@ def _run(env_level, code):
         text=True,
         env=env,
         cwd="/root/repo",
+        # a child that somehow initializes a backend (remote-TPU tunnel
+        # probe) must fail the test, not stall the whole suite
+        timeout=120,
     )
 
 

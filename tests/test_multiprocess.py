@@ -59,6 +59,14 @@ def test_two_process_exchange_and_coordination():
         for p in procs:
             p.kill()
         pytest.fail("multi-process workers timed out:\n" + "\n".join(outs))
+    # some jax builds cannot run true multi-process collectives on the CPU
+    # backend at all ("Multiprocess computations aren't implemented on the
+    # CPU backend") — a capability absence, not a regression in this repo
+    if any(
+        "Multiprocess computations aren't implemented on the CPU backend" in o
+        for o in outs
+    ):
+        pytest.skip("this jax build lacks multi-process CPU collectives")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"MP_OK {i}" in out, f"worker {i} output:\n{out}"

@@ -211,7 +211,25 @@ def main() -> None:
         ast_error = e
         print(f"astaroth bench section failed: {e!r}", file=sys.stderr)
 
+    # telemetry snapshot (STENCIL_TELEMETRY=1 / STENCIL_TELEMETRY_DIR): the
+    # per-step histogram stats, analytic exchange-bytes counters, and
+    # resilience counters ride the BENCH artifact so regressions in exchange
+    # traffic or retry counts diff across rounds like any headline field.
+    # Omitted when disabled (the default) — zero formatting cost.
+    from stencil_tpu import telemetry
+
+    if telemetry.enabled():
+        result["telemetry"] = telemetry.snapshot()
+
     print(json.dumps(result))
+    if telemetry.enabled():
+        # AFTER the artifact line: a full disk / vanished dir writing the
+        # trace must not discard the measured headline JSON (the same
+        # artifact-first rule as the astaroth section above)
+        try:
+            telemetry.write_artifacts()
+        except OSError as e:
+            print(f"telemetry artifact write failed: {e!r}", file=sys.stderr)
     if ast_error is not None:
         # loud failure AFTER the artifact: regressions stay visible without
         # discarding the measured headline data (ADVICE.md r05 finding)

@@ -63,6 +63,76 @@ def parse_strategy(args) -> PlacementStrategy:
     return PlacementStrategy.Trivial if args.trivial else PlacementStrategy.NodeAware
 
 
+def add_telemetry_flags(p: argparse.ArgumentParser) -> None:
+    """Every driver grows ``--metrics-out``: write the telemetry snapshot
+    (counters/gauges/histogram stats, JSON) to PATH at exit.  Passing it
+    turns telemetry on for the run; with ``STENCIL_TELEMETRY_DIR`` also set,
+    the run additionally leaves a JSONL event log and a Chrome trace there."""
+    p.add_argument(
+        "--metrics-out",
+        default=None,
+        metavar="PATH",
+        help="write a telemetry snapshot JSON to PATH at exit (enables "
+        "telemetry; see docs/observability.md)",
+    )
+
+
+def _write_snapshot(path: str) -> None:
+    import json
+
+    from stencil_tpu import telemetry
+
+    with open(path, "w") as f:
+        json.dump(telemetry.snapshot(), f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def telemetry_begin(args) -> None:
+    """Enable telemetry when ``--metrics-out`` asked for it (env knobs may
+    have enabled it already); call right after ``parse_args``.
+
+    An owned run starts from zeroed metrics (sequential in-process driver
+    mains must not bleed counters into each other's snapshots), and the
+    snapshot write is ALSO registered via ``atexit`` so a CLI run that dies
+    on an exception still leaves its post-mortem artifact — the failed runs
+    are the ones whose retry/descent counters matter most.  The clean path
+    (``telemetry_end``) writes and unregisters."""
+    from stencil_tpu import telemetry
+
+    path = getattr(args, "metrics_out", None)
+    if path and not telemetry.enabled():
+        telemetry.enable()
+        telemetry.reset()
+        args._telemetry_owned = True
+    if path:
+        import atexit
+
+        args._telemetry_atexit = lambda: _write_snapshot(path)
+        atexit.register(args._telemetry_atexit)
+
+
+def telemetry_end(args) -> None:
+    """Flush telemetry artifacts and write the ``--metrics-out`` snapshot on
+    ``main``'s clean exit path (the atexit hook covers crashed CLI runs)."""
+    from stencil_tpu import telemetry
+
+    if telemetry.enabled():
+        telemetry.write_artifacts()
+    path = getattr(args, "metrics_out", None)
+    if path:
+        _write_snapshot(path)
+    hook = getattr(args, "_telemetry_atexit", None)
+    if hook is not None:
+        import atexit
+
+        atexit.unregister(hook)
+        args._telemetry_atexit = None
+    if getattr(args, "_telemetry_owned", False):
+        # leave the process-global state as we found it (in-process callers:
+        # tests drive driver mains directly)
+        telemetry.disable()
+
+
 def host_round_trip_s() -> float:
     """Latency of one device->host readback (large through a tunneled dev
     backend; subtract it from device-looped timings — see bench.py)."""

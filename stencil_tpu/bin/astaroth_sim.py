@@ -55,7 +55,9 @@ def main(argv=None) -> int:
         "(one exchange per iteration, modeling Astaroth's real comm volume); "
         "wavefront: force the temporal schedule (error when not viable)",
     )
+    _common.add_telemetry_flags(p)
     args = p.parse_args(argv)
+    _common.telemetry_begin(args)
 
     num_subdoms = len(jax.devices())
     print(f"assuming {num_subdoms} subdomains", file=sys.stderr)
@@ -95,6 +97,7 @@ def main(argv=None) -> int:
             f"astaroth,{_common.method_str(args)},{ranks},{dev_count},"
             f"{x},{y},{z},{iter_time.min()},{iter_time.trimean()}"
         )
+    _common.telemetry_end(args)
     return 0
 
 

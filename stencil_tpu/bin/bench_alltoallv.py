@@ -75,7 +75,9 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser("bench-alltoallv")
     p.add_argument("--iters", type=int, default=5)
     p.add_argument("--scale", type=float, default=1.0, help="scale all matrix sizes")
+    _common.add_telemetry_flags(p)
     args = p.parse_args(argv)
+    _common.telemetry_begin(args)
 
     devices = jax.devices()
     n = len(devices)
@@ -121,6 +123,7 @@ def main(argv=None) -> int:
         print(f"{total:e}")
         print(f"{name} concurrent")
         print(f"{_common.measure_matrix_concurrent(mesh, m.astype(np.int64), args.iters):e}")
+    _common.telemetry_end(args)
     return 0
 
 

@@ -126,7 +126,9 @@ def main(argv=None) -> int:
         "per-iter time = (dispatch - host_rt) / inner; default: 1, or "
         "auto-raised when the host round trip would swamp the exchange)",
     )
+    _common.add_telemetry_flags(p)
     args = p.parse_args(argv)
+    _common.telemetry_begin(args)
 
     rt = _common.host_round_trip_s()
     if args.inner is None:
@@ -151,6 +153,7 @@ def main(argv=None) -> int:
         stats, bytes_, swept = bench(args.iters, args.quantities, ext, radius, args.inner, rt)
         if jax.process_index() == 0:
             print(report(name, bytes_, stats, swept))
+    _common.telemetry_end(args)
     return 0
 
 

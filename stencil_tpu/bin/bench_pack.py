@@ -140,24 +140,28 @@ def main(argv=None) -> int:
         help="pack+unpack round trips per device dispatch (use >1 on "
         "tunneled backends; prints roundtrip time instead of pack/unpack)",
     )
+    from stencil_tpu.bin import _common
+
+    _common.add_telemetry_flags(p)
     args = p.parse_args(argv)
+    _common.telemetry_begin(args)
 
     ext = Dim3(args.size, args.size, args.size)
     if args.inner > 1:
-        from stencil_tpu.bin._common import host_round_trip_s
-
-        rt = host_round_trip_s()
+        rt = _common.host_round_trip_s()
         for d in (Dim3(1, 0, 0), Dim3(0, 1, 0), Dim3(0, 0, 1)):
             nbytes, rt_t = bench_roundtrip(
                 ext, d, max(args.iters, 3), args.inner, args.backend, args.interpret, rt
             )
             gbps = 2 * nbytes / rt_t / 1e9  # payload packed + unpacked
             print(f"{ext} {d} {nbytes} roundtrip {rt_t:g} {gbps:.2f}GB/s")
+        _common.telemetry_end(args)
         return 0
     for d in (Dim3(1, 0, 0), Dim3(0, 1, 0), Dim3(0, 0, 1)):
         nbytes, pack_t, unpack_t = bench(ext, d, args.iters, args.backend, args.interpret)
         gbps = nbytes / min(pack_t, unpack_t) / 1e9
         print(f"{ext} {d} {nbytes} {pack_t:g} {unpack_t:g} {gbps:.2f}GB/s")
+    _common.telemetry_end(args)
     return 0
 
 

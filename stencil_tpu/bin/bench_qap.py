@@ -19,6 +19,7 @@ import time
 
 import numpy as np
 
+from stencil_tpu.bin import _common
 from stencil_tpu.parallel.qap import qap_solve, qap_solve_catch
 
 
@@ -80,10 +81,13 @@ def main(argv=None) -> int:
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--max-size", type=int, default=40)
     p.add_argument("--exact-below", type=int, default=9)
+    _common.add_telemetry_flags(p)
     args = p.parse_args(argv)
+    _common.telemetry_begin(args)
     bench("blkdiag", make_blkdiag, args.iters, args.max_size, args.exact_below)
     bench("random", make_random, args.iters, args.max_size, args.exact_below)
     bench("matched", make_matched, args.iters, args.max_size, args.exact_below)
+    _common.telemetry_end(args)
     return 0
 
 

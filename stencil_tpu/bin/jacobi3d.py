@@ -61,14 +61,18 @@ def main(argv=None) -> int:
         help="time overlap=True vs overlap=False (jnp kernel) and report the "
         "achieved-overlap delta (reference --no-overlap A/B, jacobi3d.cu:265-337)",
     )
+    _common.add_telemetry_flags(p)
     p.add_argument("x", type=int, nargs="?", default=512)
     p.add_argument("y", type=int, nargs="?", default=512)
     p.add_argument("z", type=int, nargs="?", default=512)
     args = p.parse_args(argv)
+    _common.telemetry_begin(args)
 
     x, y, z = _global_size(args)
     if args.overlap_report:
-        return _overlap_report(args, x, y, z)
+        rc = _overlap_report(args, x, y, z)
+        _common.telemetry_end(args)
+        return rc
 
     checkpoint_period = args.period if args.period > 0 else max(args.iters // 10, 1)
 
@@ -104,7 +108,7 @@ def main(argv=None) -> int:
     model.step(args.halo_multiplier)  # compile outside the timed loop
     model.block_until_ready()
 
-    from stencil_tpu.utils.profiling import trace
+    from stencil_tpu.telemetry import trace
 
     with trace(args.trace):
         for it in range(args.iters):
@@ -129,6 +133,7 @@ def main(argv=None) -> int:
             f"jacobi3d,{_common.method_str(args)},{ranks},{dev_count},"
             f"{x},{y},{z},{iter_time.min()},{iter_time.trimean()}"
         )
+    _common.telemetry_end(args)
     return 0
 
 

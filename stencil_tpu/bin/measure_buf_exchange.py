@@ -42,7 +42,11 @@ def main(argv=None) -> int:
         "reach the 4 ms target and exhaust HBM before converging",
     )
     p.add_argument("--tol", type=float, default=0.05, help="relative convergence tolerance")
+    from stencil_tpu.bin import _common
+
+    _common.add_telemetry_flags(p)
     args = p.parse_args(argv)
+    _common.telemetry_begin(args)
 
     devices = jax.devices()
     n = len(devices)
@@ -95,6 +99,7 @@ def main(argv=None) -> int:
     print("final x (MiB)")
     for i in range(n):
         print(" ".join(f"{x[i, j] / MiB:.2f}" for j in range(n)))
+    _common.telemetry_end(args)
     return 0
 
 

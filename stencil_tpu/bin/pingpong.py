@@ -19,6 +19,7 @@ import time
 
 import jax
 
+from stencil_tpu.bin import _common
 from stencil_tpu.utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
@@ -69,11 +70,14 @@ def main(argv=None) -> int:
     p.add_argument("--min", type=int, default=0, help="log2 of smallest message")
     p.add_argument("--max", type=int, default=27, help="log2 of largest message")
     p.add_argument("--iters", type=int, default=30)
+    _common.add_telemetry_flags(p)
     args = p.parse_args(argv)
+    _common.telemetry_begin(args)
 
     rows = pingpong_times(jax.devices(), args.min, args.max, args.iters)
     for name, times in rows:
         print(name + " " + " ".join(f"{t:e}" for t in times))
+    _common.telemetry_end(args)
     return 0
 
 

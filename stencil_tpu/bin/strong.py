@@ -20,10 +20,12 @@ from stencil_tpu.core.radius import Radius
 def main(argv=None) -> int:
     args = build_parser("strong").parse_args(argv)
     args.trivial = args.naive
+    _common.telemetry_begin(args)
     x, y, z = _common.fit_to_mesh(args.x, args.y, args.z, Radius.constant(3))
     row = run(x, y, z, args.n_iters, args, name="strong")
     if jax.process_index() == 0:
         print(row)
+    _common.telemetry_end(args)
     return 0
 
 

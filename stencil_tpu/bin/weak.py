@@ -75,12 +75,14 @@ def build_parser(name: str) -> argparse.ArgumentParser:
     p.add_argument("--naive", action="store_true", help="trivial placement (weak.cu --naive)")
     p.add_argument("--cuda-aware", dest="cuda_aware_mpi", action="store_true")
     p.add_argument("--staged", action="store_true")
+    _common.add_telemetry_flags(p)
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser("weak").parse_args(argv)
     args.trivial = args.naive
+    _common.telemetry_begin(args)
     devs = len(jax.devices())
     # weak.cu:63-65 round-to-nearest scaling
     x = weak_scaled_size(args.x, devs)
@@ -94,6 +96,7 @@ def main(argv=None) -> int:
     row = run(x, y, z, args.n_iters, args, name="weak")
     if jax.process_index() == 0:
         print(row)
+    _common.telemetry_end(args)
     return 0
 
 

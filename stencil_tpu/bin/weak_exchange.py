@@ -27,6 +27,7 @@ from stencil_tpu.utils.config import MethodFlags
 def main(argv=None) -> int:
     args = build_parser("weak-exchange").parse_args(argv)
     args.trivial = args.naive
+    _common.telemetry_begin(args)
     devs = len(jax.devices())
     x = weak_scaled_size(args.x, devs)
     y = weak_scaled_size(args.y, devs)
@@ -60,6 +61,7 @@ def main(argv=None) -> int:
             f"{dd.exchange_bytes_for_method(MethodFlags.CudaMpi)},0,0,0,"
             f"{args.n_iters},{ranks * dev_count},{ranks},{ranks},{elapsed:e}"
         )
+    _common.telemetry_end(args)
     return 0
 
 

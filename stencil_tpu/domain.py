@@ -32,6 +32,7 @@ the periodic wrap, and host gather/scatter masks the padding (SURVEY.md §7
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import time
@@ -55,6 +56,8 @@ from stencil_tpu.ops.exchange import (
 )
 from stencil_tpu.parallel.mesh import MESH_AXES, make_mesh
 from stencil_tpu.parallel.placement import Placement
+from stencil_tpu import telemetry
+from stencil_tpu.telemetry import names as tm
 from stencil_tpu.utils.config import MethodFlags, PlacementStrategy
 from stencil_tpu.utils.logging import log_debug, log_info
 
@@ -191,6 +194,9 @@ class DistributedDomain:
         self._divergence_every = env_int("STENCIL_DIVERGENCE_EVERY", 0, minimum=0)
         self._sentinel = None
         self._retry_policy = None
+        # analytic bytes per exchange (exchange_bytes_total), computed once
+        # per realize() for the telemetry counters
+        self._exchange_nbytes: Optional[int] = None
 
     def set_divergence_check(self, every: int) -> None:
         """Enable the divergence sentinel (resilience/sentinel.py): every
@@ -342,6 +348,13 @@ class DistributedDomain:
             t0 = time.perf_counter()
             self._exchange_fn.lower(self._curr).compile()
             self.stats.time_create = time.perf_counter() - t0
+            telemetry.observe(tm.COMPILE_SECONDS, self.stats.time_create)
+            telemetry.emit_event(
+                tm.EVENT_COMPILE,
+                phase="exchange",
+                label="realize",
+                seconds=round(self.stats.time_create, 6),
+            )
         self._realized = True
         log_info(f"realized {self._size} over mesh {dim} (raw shard {raw})")
 
@@ -565,18 +578,54 @@ class DistributedDomain:
         self._curr[h.name] = out
 
     # --- the hot path ---------------------------------------------------------
+    @contextlib.contextmanager
+    def _phase_timer(self, attr: str, histogram: str, span_name: str = None,
+                     sync: bool = False):
+        """THE timing path for the per-call hot-loop phases: one
+        ``perf_counter`` pair feeds both the reference-parity ``DomainStats``
+        accumulator (``attr``) and the telemetry histogram/span.  Active when
+        exchange-stats (the reference's blocking per-call opt-in,
+        stencil.hpp:106-131) or telemetry is enabled; otherwise it yields
+        immediately — zero per-step formatting work.  ``sync=True`` adds the
+        honest device sync timing requires (see ``block_until_ready``)."""
+        if not (self._exchange_stats or telemetry.enabled()):
+            yield
+            return
+        t0 = time.perf_counter()
+        yield
+        if sync:
+            self.block_until_ready()
+        dt = time.perf_counter() - t0
+        setattr(self.stats, attr, getattr(self.stats, attr) + dt)
+        telemetry.observe(histogram, dt)
+        if span_name is not None:
+            telemetry.record_span(span_name, t0, dt)
+
+    def _account_exchanges(self, n: int) -> None:
+        """Counter bookkeeping for ``n`` (possibly fused) halo exchanges:
+        analytic bytes via ``exchange_bytes_total`` (src/stencil.cu:6-25),
+        computed once and cached — counters are always live, so this must
+        stay a dict hit + two int adds on the hot path."""
+        if self._exchange_nbytes is None:
+            self._exchange_nbytes = (
+                self.exchange_bytes_total() if self._handles else 0
+            )
+            telemetry.set_gauge(
+                tm.EXCHANGE_BYTES_PER_EXCHANGE, self._exchange_nbytes
+            )
+        telemetry.inc(tm.EXCHANGE_COUNT, n)
+        telemetry.inc(tm.EXCHANGE_BYTES, n * self._exchange_nbytes)
+
     def exchange(self) -> None:
         """Fill every quantity's halo shell (src/stencil.cu:670-864)."""
         assert self._realized
-        t0 = time.perf_counter() if self._exchange_stats else 0.0
-        self._curr = self._exchange_fn(self._curr)
-        self._shell_stale = False
-        if self._exchange_stats:
-            # honest sync: plain block_until_ready returns before execution
-            # finishes on tunneled dev backends (see block_until_ready below)
-            self.block_until_ready()
-            self.stats.time_exchange += time.perf_counter() - t0
+        with self._phase_timer(
+            "time_exchange", tm.EXCHANGE_SECONDS, tm.SPAN_EXCHANGE, sync=True
+        ):
+            self._curr = self._exchange_fn(self._curr)
+            self._shell_stale = False
         self._exchange_count += 1
+        self._account_exchanges(1)
 
     def exchange_many(self, steps: int) -> None:
         """Run ``steps`` exchanges in ONE device dispatch (``lax.fori_loop``
@@ -596,13 +645,12 @@ class DistributedDomain:
         self._curr = self._exchange_many_fn(self._curr, steps)
         self._shell_stale = False
         self._exchange_count += steps
+        self._account_exchanges(steps)
 
     def swap(self) -> None:
         """Swap curr/next slots (src/stencil.cu:541-561)."""
-        t0 = time.perf_counter() if self._exchange_stats else 0.0
-        self._curr, self._next = self._next, self._curr
-        if self._exchange_stats:
-            self.stats.time_swap += time.perf_counter() - t0
+        with self._phase_timer("time_swap", tm.SWAP_SECONDS):
+            self._curr, self._next = self._next, self._curr
 
     def block_until_ready(self) -> None:
         """Wait for all in-flight device work on the current buffers.
@@ -925,6 +973,13 @@ class DistributedDomain:
           ``dispatch`` and this call's ``label`` (models pass their name);
         * the divergence sentinel (``set_divergence_check``) runs on its
           cadence after a successful dispatch.
+
+        This is also the TELEMETRY boundary: the dispatch counters
+        (``domain.step.*``) and analytic exchange bytes are always counted;
+        with telemetry enabled the dispatch is additionally honest-synced and
+        its wall time recorded as a span plus a per-raw-iteration histogram
+        sample (``domain.step.seconds``) — enabling telemetry therefore adds
+        one device sync per dispatch, exactly like exchange-stats.
         """
         from stencil_tpu.resilience import inject
         from stencil_tpu.resilience.retry import RetryPolicy, execute_with_retry
@@ -939,12 +994,27 @@ class DistributedDomain:
             inject.maybe_fail("dispatch", label)
             return step_fn(self._curr, steps)
 
+        raw = steps * getattr(step_fn, "_raw_steps_per_call", 1)
+        timed = telemetry.enabled()
+        t0 = time.perf_counter() if timed else 0.0
         self._curr = execute_with_retry(
             dispatch,
             label=f"dispatch:{label}",
             policy=self._retry_policy,
             buffers=lambda: self._curr,
         )
+        if timed:
+            self.block_until_ready()
+            dt = time.perf_counter() - t0
+            telemetry.record_span(tm.SPAN_STEP, t0, dt, label=label, steps=raw)
+            telemetry.observe(tm.STEP_SECONDS, dt / max(raw, 1))
+        telemetry.inc(tm.STEP_DISPATCHES)
+        telemetry.inc(tm.STEP_ITERATIONS, raw)
+        # analytic exchange traffic of the fused step: one exchange per macro
+        # (= raw iterations / halo multiplier) at exchange_bytes_total bytes —
+        # the modeled bytes, not a measured count (exchange-free single-device
+        # routes are still attributed their modeled halo traffic)
+        self._account_exchanges(max(raw // max(self._halo_mult, 1), 1))
         # streaming-engine steps advance interiors only; the carried shell
         # goes stale and raw readback must re-exchange first
         if getattr(step_fn, "_marks_shell_stale", False):
@@ -954,5 +1024,4 @@ class DistributedDomain:
         # sentinel cadence and the reported step index are in RAW iterations:
         # a macro step (halo multiplier on the xla engine) advances `mult`
         # raw iterations per dispatch-step, which the built step declares
-        raw = steps * getattr(step_fn, "_raw_steps_per_call", 1)
         self._sentinel.after_steps(self, raw)

@@ -167,6 +167,13 @@ class FaultPlan:
 
 
 def _raise(cls: FailureClass, phase: str, label: str) -> None:
+    from stencil_tpu import telemetry
+    from stencil_tpu.telemetry import names as tm
+
+    telemetry.inc(tm.FAULTS_INJECTED)
+    telemetry.emit_event(
+        tm.EVENT_FAULT, phase=phase, label=label, failure_class=cls.value
+    )
     site = f" [fault-injected at {phase}:{label}]"
     if cls is FailureClass.DIVERGENCE:
         raise DivergenceError(quantity=f"<injected:{label}>", step=-1)

@@ -31,11 +31,14 @@ descent deterministically on CPU.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Dict, Optional
 
+from stencil_tpu import telemetry
 from stencil_tpu.resilience import inject
 from stencil_tpu.resilience.retry import buffers_live
 from stencil_tpu.resilience.taxonomy import FailureClass, classify, is_degradable
+from stencil_tpu.telemetry import names as tm
 
 
 @dataclasses.dataclass
@@ -101,7 +104,16 @@ class DegradationLadder:
     def _ensure_built(self) -> Callable:
         if self._impl is None:
             inject.maybe_fail("compile", f"{self.label}:{self.rung.name}")
+            t0 = time.perf_counter()
             self._impl = self.rung.build()
+            dt = time.perf_counter() - t0
+            telemetry.observe(tm.LADDER_BUILD_SECONDS, dt)
+            telemetry.emit_event(
+                tm.EVENT_COMPILE,
+                phase="ladder",
+                label=f"{self.label}:{self.rung.name}",
+                seconds=round(dt, 6),
+            )
         return self._impl
 
     def _descend(self, cls: FailureClass, exc: BaseException) -> bool:
@@ -112,6 +124,14 @@ class DegradationLadder:
         if nxt is None:
             return False
         self.descents.append((self.rung.name, cls))
+        telemetry.inc(tm.LADDER_DESCENTS)
+        telemetry.emit_event(
+            tm.EVENT_DESCENT,
+            label=self.label,
+            from_rung=self.rung.name,
+            to_rung=nxt.name,
+            failure_class=cls.value,
+        )
         self.rung = nxt
         self._impl = None
         return True

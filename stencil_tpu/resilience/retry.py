@@ -22,7 +22,9 @@ import dataclasses
 import time
 from typing import Callable, Iterable, Optional
 
+from stencil_tpu import telemetry
 from stencil_tpu.resilience.taxonomy import FailureClass, classify
+from stencil_tpu.telemetry import names as tm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +95,13 @@ def execute_with_retry(
             if classify(e) is not FailureClass.TRANSIENT_RUNTIME:
                 raise
             if attempt >= policy.max_retries:
+                telemetry.inc(tm.RETRY_EXHAUSTED)
+                telemetry.emit_event(
+                    tm.EVENT_RETRY_EXHAUSTED,
+                    label=label,
+                    max_retries=policy.max_retries,
+                    error=str(e)[:300],
+                )
                 log_warn(
                     f"{label}: transient failure persisted through "
                     f"{policy.max_retries} retries; giving up: {e}"
@@ -100,6 +109,10 @@ def execute_with_retry(
                 raise
             candidates = buffers() if buffers is not None else (args, kwargs)
             if not buffers_live(candidates):
+                telemetry.inc(tm.RETRY_REFUSED)
+                telemetry.emit_event(
+                    tm.EVENT_RETRY_REFUSED, label=label, error=str(e)[:300]
+                )
                 log_warn(
                     f"{label}: transient failure but an input buffer was "
                     "already donated (deleted) — retry would reuse freed "
@@ -108,6 +121,15 @@ def execute_with_retry(
                 raise
             delay = policy.delay_s(attempt)
             attempt += 1
+            telemetry.inc(tm.RETRY_ATTEMPTS)
+            telemetry.emit_event(
+                tm.EVENT_RETRY,
+                label=label,
+                attempt=attempt,
+                max_retries=policy.max_retries,
+                delay_s=delay,
+                error=str(e)[:300],
+            )
             log_warn(
                 f"{label}: transient failure "
                 f"(attempt {attempt}/{policy.max_retries}), retrying in "

@@ -50,4 +50,11 @@ class DivergenceSentinel:
                 continue  # integer fields cannot go non-finite
             vals = dd.quantity_to_host(h)
             if not np.isfinite(vals).all():
+                from stencil_tpu import telemetry
+                from stencil_tpu.telemetry import names as tm
+
+                telemetry.inc(tm.SENTINEL_TRIPS)
+                telemetry.emit_event(
+                    tm.EVENT_DIVERGENCE, quantity=h.name, step=self.steps_done
+                )
                 raise DivergenceError(quantity=h.name, step=self.steps_done)

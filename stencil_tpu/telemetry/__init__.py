@@ -1,0 +1,244 @@
+"""Unified telemetry: metrics registry, span tracer, structured event log.
+
+The measurement substrate under every perf claim this repo makes: the
+reference instruments every phase with NVTX ranges and reports Statistics
+CSVs per benchmark (src/stencil.cu:672-861, bin/statistics.hpp); here the
+same visibility is one process-local facade:
+
+* **metrics** (``metrics.py``) — counters / gauges / histograms, histograms
+  backed by ``utils/statistics.Statistics`` (trimean and friends for free).
+  ``snapshot()`` returns the JSON-safe dict ``bench.py`` embeds in the
+  BENCH artifact and every ``bin/`` driver writes via ``--metrics-out``.
+* **spans** (``spans.py``) — nestable wall-clock spans dumped as Chrome
+  trace-event JSON (``chrome://tracing`` / Perfetto); also home of the
+  ``annotate``/``trace`` jax wrappers that used to live in
+  ``utils/profiling.py``.
+* **events** (``events.py``) — rank-tagged JSONL event log for the signals
+  a program must consume (retries, ladder descents, divergence trips).
+
+Knobs (validated reads — ``utils/config.py`` pattern):
+
+* ``STENCIL_TELEMETRY=1|0``     — master switch (default: on iff a dir is set)
+* ``STENCIL_TELEMETRY_DIR=D``   — output dir for events + traces; implies on
+* ``STENCIL_TELEMETRY_EVENTS``  — JSONL sink on/off (default: on iff dir set)
+
+Design rules (enforced here, asserted by tests):
+
+* **zero-cost when disabled** — ``span()`` yields immediately, ``observe``/
+  ``emit_event`` return after one attribute check, no formatting happens.
+  Counters/gauges stay live always (an int add; a post-mortem ``snapshot()``
+  after a failed run still counts its retries).
+* **never initialize a jax backend** — rank tags use the fail-closed
+  ``logging._rank`` probe; spans enter ``jax.named_scope`` only when jax is
+  already imported.
+* **no free-string names** — call sites name series through
+  ``telemetry.names`` constants; ``scripts/check_telemetry_names.py`` lints.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Optional
+
+from stencil_tpu.telemetry import names  # noqa: F401  (re-export)
+from stencil_tpu.telemetry.events import EventSink
+from stencil_tpu.telemetry.metrics import MetricsRegistry
+from stencil_tpu.telemetry.spans import (  # noqa: F401  (annotate/trace re-export)
+    SpanRecorder,
+    _maybe_named_scope,
+    annotate,
+    trace,
+)
+from stencil_tpu.utils.logging import _rank
+
+
+class _Telemetry:
+    """Process-local singleton state (module functions below delegate)."""
+
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self.spans = SpanRecorder()
+        self.sink: Optional[EventSink] = None
+        self.enabled = False
+        self.out_dir: Optional[str] = None
+        self._configured = False
+
+    def configure_from_env(self) -> None:
+        from stencil_tpu.utils.config import env_bool
+
+        out_dir = os.environ.get("STENCIL_TELEMETRY_DIR") or None
+        enabled = env_bool("STENCIL_TELEMETRY", out_dir is not None)
+        events = env_bool("STENCIL_TELEMETRY_EVENTS", out_dir is not None)
+        if events and out_dir is None and "STENCIL_TELEMETRY_EVENTS" in os.environ:
+            # an explicit EVENTS=1 with nowhere to write is a config error
+            # even when the master switch is off — the user asked for a JSONL
+            # log they would silently never get
+            raise ValueError(
+                "STENCIL_TELEMETRY_EVENTS=1 needs STENCIL_TELEMETRY_DIR to "
+                "point at a writable directory (events are a JSONL file; "
+                "set the dir or unset STENCIL_TELEMETRY_EVENTS)"
+            )
+        self.enabled = enabled
+        self.out_dir = out_dir
+        self.sink = EventSink(out_dir) if (enabled and events and out_dir) else None
+        self._configured = True
+
+
+_t = _Telemetry()
+
+
+def _cfg() -> _Telemetry:
+    if not _t._configured:
+        _t.configure_from_env()
+    return _t
+
+
+# --- lifecycle ---------------------------------------------------------------
+
+
+def enabled() -> bool:
+    return _cfg().enabled
+
+
+def enable(dir: Optional[str] = None, events: Optional[bool] = None) -> None:
+    """Programmatic enable (tests, driver ``--metrics-out``).  ``dir`` adds
+    the JSONL event sink and gives Chrome-trace dumps a default home;
+    without it, spans/histograms record in memory only."""
+    t = _t
+    t._configured = True
+    t.enabled = True
+    if dir is not None:
+        t.out_dir = str(dir)
+        os.makedirs(t.out_dir, exist_ok=True)
+    if events is None:
+        events = t.out_dir is not None
+    if events and t.out_dir is None:
+        raise ValueError("telemetry events need a directory (enable(dir=...))")
+    if t.sink is not None:
+        t.sink.close()
+    t.sink = EventSink(t.out_dir) if events else None
+
+
+def disable() -> None:
+    t = _t
+    t._configured = True
+    t.enabled = False
+    if t.sink is not None:
+        t.sink.close()
+        t.sink = None
+    t.out_dir = None
+
+
+def reset() -> None:
+    """Clear all recorded metrics and spans (counters restart at 0)."""
+    t = _cfg()
+    t.registry.reset()
+    t.spans.clear()
+
+
+# --- metrics -----------------------------------------------------------------
+
+
+def inc(name: str, value: int = 1) -> None:
+    """Increment a counter.  Always live (a dict hit + int add)."""
+    _cfg().registry.counter(name).inc(value)
+
+
+def set_gauge(name: str, value: float) -> None:
+    _cfg().registry.gauge(name).set(value)
+
+
+def observe(name: str, value: float) -> None:
+    """Record one histogram sample — only while telemetry is enabled, so a
+    disabled hot loop never touches the Statistics list."""
+    t = _cfg()
+    if t.enabled:
+        t.registry.histogram(name).observe(value)
+
+
+def snapshot() -> dict:
+    """JSON-safe dict of all metrics.  Every canonical counter name appears
+    (0 when untouched) so snapshots diff cleanly across rounds."""
+    return _cfg().registry.snapshot(seed_counters=names.ALL_COUNTERS)
+
+
+# --- spans -------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def span(name: str, histogram: Optional[str] = None, **args):
+    """Nestable wall-clock span.  When disabled: an immediate yield, nothing
+    recorded.  When enabled: records a Chrome-trace event (nested under the
+    enclosing span), optionally observes the duration into ``histogram``,
+    and labels the region in HLO/XProf if jax is already up."""
+    t = _cfg()
+    if not t.enabled:
+        yield
+        return
+    parent = t.spans.current()
+    t.spans.push(name)
+    t0 = time.perf_counter()
+    try:
+        with _maybe_named_scope(name):
+            yield
+    finally:
+        dur = time.perf_counter() - t0
+        t.spans.pop()
+        t.spans.record(name, t0, dur, parent=parent, **args)
+        if histogram is not None:
+            t.registry.histogram(histogram).observe(dur)
+
+
+def record_span(
+    name: str, t0: float, dur: float, histogram: Optional[str] = None, **args
+) -> None:
+    """Post-hoc span record for call sites that already timed themselves
+    (``t0`` from ``time.perf_counter``, ``dur`` seconds).  No-op disabled."""
+    t = _cfg()
+    if not t.enabled:
+        return
+    t.spans.record(name, t0, dur, **args)
+    if histogram is not None:
+        t.registry.histogram(histogram).observe(dur)
+
+
+def dump_chrome_trace(path: Optional[str] = None) -> Optional[str]:
+    """Write the recorded spans as Chrome trace-event JSON; returns the path
+    (None when there is nothing to write or nowhere to put it).  Open in
+    chrome://tracing or https://ui.perfetto.dev."""
+    t = _cfg()
+    events = t.spans.chrome_trace_events(pid=_rank())
+    if not events:
+        return None
+    if path is None:
+        if t.out_dir is None:
+            return None
+        path = os.path.join(t.out_dir, f"trace_{_rank()}.json")
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+# --- events ------------------------------------------------------------------
+
+
+def emit_event(name: str, **fields) -> None:
+    """Append one structured JSONL event.  No-op unless enabled AND a sink
+    directory is configured — guarded before any formatting happens."""
+    t = _cfg()
+    if t.enabled and t.sink is not None:
+        t.sink.emit(name, fields)
+
+
+def event_log_path() -> Optional[str]:
+    t = _cfg()
+    return t.sink.path() if t.sink is not None else None
+
+
+def write_artifacts() -> dict:
+    """Flush end-of-run artifacts (the Chrome trace; events stream live).
+    Returns ``{"trace": path_or_None, "events": path_or_None}``."""
+    return {"trace": dump_chrome_trace(), "events": event_log_path()}

@@ -1,0 +1,142 @@
+"""Process-local metrics registry: counters, gauges, histograms.
+
+Histograms are backed by ``utils/statistics.Statistics`` — the reference's
+benchmark aggregate (bin/statistics.hpp) — so every timing series reports
+the same min/max/avg/stddev/med/**trimean** the reference's CSVs headline,
+and a BENCH-JSON telemetry section is directly comparable to the
+reference's per-benchmark Statistics rows.
+
+Counters and gauges are plain in-process numbers (one dict lookup + an add
+under the GIL); they carry no formatting or I/O, so they stay recorded even
+when telemetry output is disabled — a post-hoc ``snapshot()`` after a failed
+run still shows how many retries/descents happened.  Snapshot values are
+JSON-safe: NaN statistics (empty histogram, single-sample stddev) become
+``None``, never the non-strict-JSON ``NaN`` token.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, Optional
+
+from stencil_tpu.utils.statistics import Statistics
+
+
+def _json_safe(x: float) -> Optional[float]:
+    return None if isinstance(x, float) and math.isnan(x) else x
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-value-wins numeric gauge."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Value distribution with the reference's Statistics aggregates."""
+
+    __slots__ = ("name", "stats")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.stats = Statistics()
+
+    def observe(self, v: float) -> None:
+        self.stats.insert(v)
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        s = self.stats
+        return {
+            "count": s.count(),
+            "min": _json_safe(s.min()),
+            "max": _json_safe(s.max()),
+            "avg": _json_safe(s.avg()),
+            "stddev": _json_safe(s.stddev()),
+            "med": _json_safe(s.med()),
+            "trimean": _json_safe(s.trimean()),
+        }
+
+
+class MetricsRegistry:
+    """Get-or-create registry of the three metric kinds.
+
+    A name owns ONE kind: registering it as a second kind raises (the same
+    name reported as both a counter and a histogram would silently fork the
+    series across rounds).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def _get(self, table, name: str, factory):
+        m = table.get(name)
+        if m is not None:
+            return m
+        with self._lock:
+            m = table.get(name)
+            if m is None:
+                for other in (self._counters, self._gauges, self._histograms):
+                    if other is not table and name in other:
+                        raise ValueError(
+                            f"telemetry name {name!r} already registered as a "
+                            "different metric kind"
+                        )
+                m = table[name] = factory(name)
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(self._histograms, name, Histogram)
+
+    def snapshot(self, seed_counters: Iterable[str] = ()) -> dict:
+        """Plain-dict snapshot.  ``seed_counters`` names appear with value 0
+        even when never incremented, so the snapshot schema is stable across
+        rounds (a diff shows '0 -> 3 retries', not a key appearing)."""
+        counters = {name: 0 for name in sorted(seed_counters)}
+        counters.update({c.name: c.value for c in self._counters.values()})
+        return {
+            "counters": dict(sorted(counters.items())),
+            "gauges": {g.name: g.value for g in sorted_values(self._gauges)},
+            "histograms": {
+                h.name: h.snapshot() for h in sorted_values(self._histograms)
+            },
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+def sorted_values(table: dict):
+    return (table[k] for k in sorted(table))

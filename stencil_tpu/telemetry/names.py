@@ -1,0 +1,123 @@
+"""Canonical telemetry names — THE single registry of every metric, span,
+and event name this tree may emit.
+
+Every call into the telemetry facade (``telemetry.inc`` / ``observe`` /
+``set_gauge`` / ``emit_event`` / ``span`` / ``record_span``) must name its
+series through a constant defined here; ``scripts/check_telemetry_names.py``
+(wired as a tier-1 test) rejects free-string names at call sites.  One
+module of constants keeps the cross-round BENCH diffs stable: a renamed or
+typo'd series fails the lint instead of silently forking the time series.
+
+Naming scheme: ``<subsystem>.<noun>[.<unit>]``, lowercase, dots between
+levels, underscores inside a level.  Histograms of seconds end in
+``.seconds``; byte counters end in ``.bytes``.
+"""
+
+from __future__ import annotations
+
+# --- counters (monotonic; always recorded, snapshot seeds all of these) -----
+
+#: halo exchanges accounted (direct ``exchange()``/``exchange_many()`` calls
+#: plus one per fused-step exchange inside ``run_step`` dispatches)
+EXCHANGE_COUNT = "domain.exchange.count"
+#: analytic bytes moved by those exchanges (``exchange_bytes_total`` per
+#: exchange — the reference's exchange_bytes_for_method accounting)
+EXCHANGE_BYTES = "domain.exchange.bytes"
+#: ``run_step`` dispatches (device-side loops of many raw iterations)
+STEP_DISPATCHES = "domain.step.dispatches"
+#: raw stencil iterations advanced through ``run_step``
+STEP_ITERATIONS = "domain.step.iterations"
+#: transient-failure retry attempts (resilience/retry.py)
+RETRY_ATTEMPTS = "resilience.retry.attempts"
+#: retries abandoned after exhausting the policy budget
+RETRY_EXHAUSTED = "resilience.retry.exhausted"
+#: retries refused by the donated-buffer liveness guard
+RETRY_REFUSED = "resilience.retry.refused"
+#: degradation-ladder descents (resilience/ladder.py)
+LADDER_DESCENTS = "resilience.ladder.descents"
+#: faults raised by the STENCIL_FAULT_PLAN hook (resilience/inject.py)
+FAULTS_INJECTED = "resilience.faults.injected"
+#: divergence-sentinel NaN/Inf detections (resilience/sentinel.py)
+SENTINEL_TRIPS = "resilience.sentinel.trips"
+
+ALL_COUNTERS = frozenset({
+    EXCHANGE_COUNT,
+    EXCHANGE_BYTES,
+    STEP_DISPATCHES,
+    STEP_ITERATIONS,
+    RETRY_ATTEMPTS,
+    RETRY_EXHAUSTED,
+    RETRY_REFUSED,
+    LADDER_DESCENTS,
+    FAULTS_INJECTED,
+    SENTINEL_TRIPS,
+})
+
+# --- gauges (last-value) -----------------------------------------------------
+
+#: analytic bytes per single exchange across all subdomains
+EXCHANGE_BYTES_PER_EXCHANGE = "domain.exchange.bytes_per_exchange"
+
+ALL_GAUGES = frozenset({EXCHANGE_BYTES_PER_EXCHANGE})
+
+# --- histograms (Statistics-backed: min/max/avg/stddev/med/trimean) ----------
+
+#: wall seconds per RAW iteration through ``run_step`` (dispatch time / raw
+#: steps, honest-synced)
+STEP_SECONDS = "domain.step.seconds"
+#: wall seconds per direct ``exchange()`` call (honest-synced)
+EXCHANGE_SECONDS = "domain.exchange.seconds"
+#: wall seconds per ``swap()`` call
+SWAP_SECONDS = "domain.swap.seconds"
+#: exchange trace+compile seconds at ``realize()`` (the CUDA-Graph-capture
+#: analog, DomainStats.time_create)
+COMPILE_SECONDS = "domain.compile.seconds"
+#: degradation-ladder rung build (trace/compile) seconds
+LADDER_BUILD_SECONDS = "resilience.ladder.build_seconds"
+
+ALL_HISTOGRAMS = frozenset({
+    STEP_SECONDS,
+    EXCHANGE_SECONDS,
+    SWAP_SECONDS,
+    COMPILE_SECONDS,
+    LADDER_BUILD_SECONDS,
+})
+
+# --- spans (Chrome-trace timeline entries) -----------------------------------
+
+SPAN_STEP = "domain.step"
+SPAN_EXCHANGE = "domain.exchange"
+SPAN_SWAP = "domain.swap"
+
+ALL_SPANS = frozenset({SPAN_STEP, SPAN_EXCHANGE, SPAN_SWAP})
+
+# --- structured events (JSONL sink) ------------------------------------------
+
+#: a compile happened (fields: phase, label, seconds)
+EVENT_COMPILE = "domain.compile"
+#: a transient failure is being retried (fields: label, attempt,
+#: max_retries, delay_s, error)
+EVENT_RETRY = "resilience.retry"
+#: the retry budget ran out (fields: label, max_retries, error)
+EVENT_RETRY_EXHAUSTED = "resilience.retry_exhausted"
+#: a retry was refused by the donated-buffer guard (fields: label, error)
+EVENT_RETRY_REFUSED = "resilience.retry_refused"
+#: a ladder descent (fields: label, from_rung, to_rung, failure_class)
+EVENT_DESCENT = "resilience.descent"
+#: a STENCIL_FAULT_PLAN fault fired (fields: phase, label, failure_class)
+EVENT_FAULT = "resilience.fault_injected"
+#: the divergence sentinel tripped (fields: quantity, step)
+EVENT_DIVERGENCE = "resilience.divergence"
+
+ALL_EVENTS = frozenset({
+    EVENT_COMPILE,
+    EVENT_RETRY,
+    EVENT_RETRY_EXHAUSTED,
+    EVENT_RETRY_REFUSED,
+    EVENT_DESCENT,
+    EVENT_FAULT,
+    EVENT_DIVERGENCE,
+})
+
+#: every registered name, any kind — what the lint checks literals against
+ALL_NAMES = ALL_COUNTERS | ALL_GAUGES | ALL_HISTOGRAMS | ALL_SPANS | ALL_EVENTS

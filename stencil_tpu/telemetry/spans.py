@@ -1,0 +1,124 @@
+"""Nestable wall-clock span tracer + Chrome trace-event dump.
+
+Subsumes ``utils/profiling.py``: ``annotate`` (the NVTX-range analog —
+``jax.named_scope`` labels the region in compiled HLO and XProf timelines)
+and ``trace`` (a ``jax.profiler`` capture) live here now, alongside the
+host-side span recorder.
+
+Spans record (name, start, duration, thread, parent, args) tuples that
+``chrome_trace_events`` renders as Chrome trace-event JSON — complete
+("ph":"X") events with microsecond timestamps — viewable in
+``chrome://tracing`` or https://ui.perfetto.dev.  Timestamps are
+``time.perf_counter`` offsets from the recorder's epoch: monotonic and
+mutually consistent, which is all the trace viewers need.
+
+jax is touched ONLY if it is already imported (``sys.modules`` probe, the
+same fail-closed rule as ``logging._rank``): recording a span must never
+pull in — let alone initialize — a jax backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+from typing import List, Optional
+
+
+def annotate(name: str):
+    """Label a region in traces and HLO (the NVTX range analog)."""
+    import jax
+
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]):
+    """Capture a ``jax.profiler`` trace into ``log_dir`` (no-op when None).
+    View with TensorBoard's profile plugin / xprof."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def _maybe_named_scope(name: str):
+    """``jax.named_scope`` when jax is ALREADY imported, else a null context
+    — a span must never import jax on behalf of the caller."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return contextlib.nullcontext()
+    return jax.named_scope(name)
+
+
+class SpanRecorder:
+    """Thread-safe recorder of completed spans with a per-thread name stack
+    (so a span knows its parent at record time)."""
+
+    def __init__(self):
+        self.epoch = time.perf_counter()
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._tls = threading.local()
+
+    # --- the per-thread nesting stack ----------------------------------------
+    def _stack(self) -> list:
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
+
+    def current(self) -> Optional[str]:
+        s = self._stack()
+        return s[-1] if s else None
+
+    def push(self, name: str) -> None:
+        self._stack().append(name)
+
+    def pop(self) -> None:
+        s = self._stack()
+        if s:
+            s.pop()
+
+    # --- recording ------------------------------------------------------------
+    def record(self, name: str, t0: float, dur: float, parent=None, **args) -> None:
+        """Record a completed span.  ``t0`` is a ``time.perf_counter`` value;
+        ``dur`` is seconds."""
+        if parent is None:
+            parent = self.current()
+        ev = {
+            "name": name,
+            "ts": (t0 - self.epoch) * 1e6,  # µs, trace-event convention
+            "dur": dur * 1e6,
+            "tid": threading.get_ident() & 0xFFFF,
+            "args": dict(args, parent=parent) if parent else dict(args),
+        }
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def chrome_trace_events(self, pid: int = 0) -> List[dict]:
+        """The recorded spans as Chrome trace-event dicts (complete events)."""
+        return [
+            {
+                "name": e["name"],
+                "ph": "X",
+                "ts": e["ts"],
+                "dur": e["dur"],
+                "pid": pid,
+                "tid": e["tid"],
+                "args": e["args"],
+            }
+            for e in self.events()
+        ]

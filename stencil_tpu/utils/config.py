@@ -55,6 +55,27 @@ def env_float(name: str, default: float, minimum: float = None) -> float:
     return _parse_env(name, raw, float, "number", minimum)
 
 
+_BOOL_WORDS = {
+    "1": True, "true": True, "yes": True, "on": True,
+    "0": False, "false": False, "no": False, "off": False,
+}
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """``env_int`` for booleans: 1/true/yes/on and 0/false/no/off; anything
+    else raises naming the variable at the read site."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    val = _BOOL_WORDS.get(raw.strip().lower())
+    if val is None:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid boolean (use 1/0, true/false, "
+            f"yes/no, on/off — or unset {name})"
+        )
+    return val
+
+
 class MethodFlags(enum.Flag):
     Non = 0
     # TPU-native methods

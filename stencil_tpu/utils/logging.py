@@ -11,6 +11,7 @@ symbolic names (SPEW..FATAL, like the CMake option) and the numeric values.
 
 from __future__ import annotations
 
+import datetime
 import os
 import sys
 
@@ -34,9 +35,33 @@ def _parse_level(raw: str) -> int:
 _LEVEL = _parse_level(os.environ.get("STENCIL_OUTPUT_LEVEL", "INFO"))
 
 
+def _parse_timestamps() -> bool:
+    # validated boolean read (utils/config.py pattern) — but a logging import
+    # must never crash the process, so like STENCIL_OUTPUT_LEVEL above a
+    # malformed value warns and falls back to the default
+    from stencil_tpu.utils.config import env_bool
+
+    try:
+        return env_bool("STENCIL_LOG_TIMESTAMPS", False)
+    except ValueError as e:
+        print(f"WARN {e}; timestamps stay off", file=sys.stderr)
+        return False
+
+
+# ISO-8601 UTC timestamps on every line (STENCIL_LOG_TIMESTAMPS=1): off by
+# default to preserve the reference line format, on when log lines must be
+# correlated with telemetry JSONL events (whose ``ts`` is epoch seconds)
+_TIMESTAMPS = _parse_timestamps()
+
+
 def set_level(level) -> None:
     global _LEVEL
     _LEVEL = _parse_level(str(level))
+
+
+def set_timestamps(on: bool = True) -> None:
+    global _TIMESTAMPS
+    _TIMESTAMPS = bool(on)
 
 
 def _rank() -> int:
@@ -62,37 +87,54 @@ def _rank() -> int:
         return 0
 
 
-def _emit(verbosity: int, msg: str) -> None:
-    # print when configured level >= message verbosity (logging.hpp:12-53)
+def _emit(verbosity: int, msg: str, stacklevel: int = 2) -> None:
+    # print when configured level >= message verbosity (logging.hpp:12-53).
+    # ``stacklevel`` counts frames above _emit to the line being attributed
+    # (2 = the caller of a log_* function); a wrapper that forwards to log_*
+    # passes a larger stacklevel so its CALLER's file:line is tagged, not the
+    # wrapper's.  An out-of-range walk degrades to "?:0" rather than raising
+    # from inside a log line.
     if _LEVEL < verbosity:
         return
-    f = sys._getframe(2)
-    tag = f"[{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}]{{{_rank()}}}"
-    print(f"{_NAMES[verbosity]}{tag} {msg}", file=sys.stderr)
+    try:
+        f = sys._getframe(stacklevel)
+        fname, lineno = os.path.basename(f.f_code.co_filename), f.f_lineno
+    except ValueError:
+        fname, lineno = "?", 0
+    stamp = ""
+    if _TIMESTAMPS:
+        stamp = (
+            datetime.datetime.now(datetime.timezone.utc).isoformat(
+                timespec="microseconds"
+            )
+            + " "
+        )
+    tag = f"[{fname}:{lineno}]{{{_rank()}}}"
+    print(f"{stamp}{_NAMES[verbosity]}{tag} {msg}", file=sys.stderr)
 
 
-def log_spew(msg: str) -> None:
-    _emit(SPEW, msg)
+def log_spew(msg: str, stacklevel: int = 1) -> None:
+    _emit(SPEW, msg, stacklevel + 1)
 
 
-def log_debug(msg: str) -> None:
-    _emit(DEBUG, msg)
+def log_debug(msg: str, stacklevel: int = 1) -> None:
+    _emit(DEBUG, msg, stacklevel + 1)
 
 
-def log_info(msg: str) -> None:
-    _emit(INFO, msg)
+def log_info(msg: str, stacklevel: int = 1) -> None:
+    _emit(INFO, msg, stacklevel + 1)
 
 
-def log_warn(msg: str) -> None:
-    _emit(WARN, msg)
+def log_warn(msg: str, stacklevel: int = 1) -> None:
+    _emit(WARN, msg, stacklevel + 1)
 
 
-def log_error(msg: str) -> None:
-    _emit(ERROR, msg)
+def log_error(msg: str, stacklevel: int = 1) -> None:
+    _emit(ERROR, msg, stacklevel + 1)
 
 
-def log_fatal(msg: str) -> None:
+def log_fatal(msg: str, stacklevel: int = 1) -> None:
     """Unlike the reference's exit(1) (logging.hpp:47-50), raise — a Python
     framework should unwind, not kill the interpreter under the user."""
-    _emit(FATAL, msg)
+    _emit(FATAL, msg, stacklevel + 1)
     raise RuntimeError(msg)

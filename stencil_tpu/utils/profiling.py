@@ -1,31 +1,12 @@
-"""Tracing/profiling hooks.
+"""DEPRECATED shim — the tracing helpers moved to ``stencil_tpu.telemetry``.
 
-Parity target: the reference's NVTX ranges around every phase
-(src/stencil.cu:672-861, tx_cuda.cuh sends, jacobi3d.cu:276) and its
-nsys/nvprof workflow (README.md:60-96).  On TPU the equivalents are
-``jax.profiler`` traces (viewable in TensorBoard/XProf) and
-``jax.named_scope`` annotations, which label the corresponding regions in the
-compiled HLO and in profile timelines.
+``annotate`` (the NVTX-range analog, ``jax.named_scope``) and ``trace``
+(``jax.profiler`` capture) now live in ``stencil_tpu/telemetry/spans.py``,
+next to the wall-clock span tracer and the Chrome-trace dump that subsumed
+this module's role.  Import from ``stencil_tpu.telemetry`` instead; this
+shim re-exports for backward compatibility.
 """
 
 from __future__ import annotations
 
-import contextlib
-
-import jax
-
-
-def annotate(name: str):
-    """Label a region in traces and HLO (the NVTX range analog)."""
-    return jax.named_scope(name)
-
-
-@contextlib.contextmanager
-def trace(log_dir: str | None):
-    """Capture a ``jax.profiler`` trace into ``log_dir`` (no-op when None).
-    View with TensorBoard's profile plugin / xprof."""
-    if not log_dir:
-        yield
-        return
-    with jax.profiler.trace(log_dir):
-        yield
+from stencil_tpu.telemetry.spans import annotate, trace  # noqa: F401

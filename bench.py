@@ -27,7 +27,20 @@ astaroth failure records its fields as null while the driver still exits
 nonzero — a transient remote-compile drop in the last section can no longer
 discard already-measured results.  Transient dispatch failures additionally
 retry with backoff inside ``DistributedDomain.run_step``
-(resilience/retry.py).
+(resilience/retry.py).  ``STENCIL_COMPILE_CACHE_DIR`` additionally persists
+XLA executables across runs so repeats stop re-paying the flaky
+remote-compile tunnel at all (utils/config.apply_compile_cache).
+
+MEASUREMENT (PERF_NOTES.md "Measurement discipline"): the headline and
+exchange-path sections alternate within one process with the rep-0
+post-idle burst discarded and the steady-state MEDIAN reported — a
+sequential best-of-N would spuriously favor whichever section ran first
+(the burst is worth up to ~35%).  Before any timing, the measurement-driven
+autotuner (stencil_tpu/tune/, docs/tuning.md) qualifies the wrap kernel's
+temporal depth for THIS chip under the same protocol; with a warm persisted
+cache that is zero trials, and the decision + steady-state numbers ride the
+BENCH JSON under ``"tune"``.  ``STENCIL_TUNE=0`` pins the static
+calibrated constants.
 
 Testability knobs (used by the CPU fault-injection test, harmless on TPU):
 ``STENCIL_BENCH_SIZE`` shrinks the domain (default 512; small sizes also
@@ -38,7 +51,6 @@ pallas kernels in interpreter mode.
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
 
@@ -86,42 +98,67 @@ def measured_copy_gbps(rt: float, n: int = 514, steps: int = 50) -> float:
 
 
 def main() -> None:
+    import statistics as _stats
+
     import jax
     import jax.numpy as jnp
 
+    from stencil_tpu import tune
     from stencil_tpu.models.jacobi import Jacobi3D
-    from stencil_tpu.utils.config import env_int
+    from stencil_tpu.tune.trial import measure_alternating
+    from stencil_tpu.utils.config import env_bool, env_int
 
     dev = jax.devices()[0]
     size = env_int("STENCIL_BENCH_SIZE", 512, minimum=8)
-    interpret = os.environ.get("STENCIL_BENCH_INTERPRET", "0") == "1"
+    interpret = env_bool("STENCIL_BENCH_INTERPRET", False)
     full = size >= 256
     rt = host_round_trip_s()
+    cells = float(size) ** 3
 
-    def timed_run(model, iters, attempts=8):
-        # warmup + compile (device-side iteration: one dispatch runs many
-        # steps).  steps is a static arg, so warm up with the SAME count as
-        # the timed run — a different count would compile a new executable
-        # inside the timing.
-        model.step(iters)
-        float(jnp.sum(model.dd.get_curr(model.h)))  # force completion
-        dt = float("inf")
-        # best-of-8: each attempt is ~0.1-0.3 s and the chip is time-shared
-        # with minute-scale contention waves, so more cheap attempts beat
-        # longer ones for catching a quiet window
-        for _ in range(attempts):
-            t0 = time.perf_counter()
-            model.step(iters)
-            float(jnp.sum(model.dd.get_curr(model.h)))
-            dt = min(dt, (time.perf_counter() - t0 - rt) / iters)
-        return dt
+    # --- autotune the headline (wrap) workload for THIS chip ---------------
+    # Warm cache: zero trials, the persisted config just rides the artifact.
+    # Cold cache: the burst-aware search qualifies the depth grid once; the
+    # static pick is one of the candidates, so the winner is never worse
+    # than the no-tune fallback under the same protocol.  Tuning failures
+    # must never cost the headline: fall back to static and keep going.
+    tune_json = {"enabled": tune.enabled(), "source": None, "config": None,
+                 "trials": 0, "pruned": 0, "cache_hit": False,
+                 "tuned_mcells_per_s": None, "static_mcells_per_s": None}
+    if tune.enabled():
+        try:
+            from stencil_tpu.tune.runners import autotune_jacobi_wrap
+
+            report = autotune_jacobi_wrap(
+                size, size, size, interpret=interpret,
+                reps=3 if full else 2, rt=rt,
+            )
+            tune_json.update(
+                source=report.source, config=report.config,
+                trials=report.trials, pruned=report.pruned,
+                cache_hit=report.cache_hit,
+            )
+
+            def _mcells(res):
+                if res is None or res.seconds_per_iter is None:
+                    return None
+                return round(cells / res.seconds_per_iter / 1e6, 1)
+
+            if report.config is not None:
+                tune_json["tuned_mcells_per_s"] = _mcells(
+                    report.result_for(report.config)
+                )
+            if report.static_config is not None:
+                tune_json["static_mcells_per_s"] = _mcells(
+                    report.result_for(report.static_config)
+                )
+        except Exception as e:  # noqa: BLE001 — tuning is an accelerator,
+            # not a dependency: the static-config headline must survive it
+            print(f"autotune section failed (static fallback): {e!r}",
+                  file=sys.stderr)
 
     model = Jacobi3D(size, size, size, devices=[dev], kernel_impl="pallas",
                      interpret=interpret)
     model.realize()
-    dt = timed_run(model, 200 if full else 4, attempts=8 if full else 2)
-    cells = float(size) ** 3
-    mcells_per_s = cells / dt / 1e6
 
     # the PRODUCTION multi-device path (m-shell exchange + m-level wavefront
     # kernel) on a mesh of all visible chips — self-permute at 1 chip — so
@@ -134,17 +171,43 @@ def main() -> None:
         )
         ex_model.realize()
         assert ex_model._pallas_path == "wavefront"
-        ex_dt = timed_run(ex_model, 100 if full else 4, attempts=8 if full else 2)
-        ex_mcells_per_s = round(cells / ex_dt / 1e6 / max(1, ndev), 1)  # per chip
         ex_path = f"wavefront_m{ex_model._wavefront_m}"
     # ONLY the expected planning failure (a device count that pads the size)
     # may be skipped; an AssertionError or a kernel failure in the wavefront
     # route is a real regression and must fail the artifact
     except ValueError as e:
         print(f"exchange-path bench skipped: {e}", file=sys.stderr)
-        ex_mcells_per_s = None
         ex_path = None
         ex_model = None  # drop any shard buffers realize() allocated
+
+    # --- burst-aware protocol: alternate the sections within one process ---
+    # (PERF_NOTES "Measurement discipline": a per-section best-of-N harvests
+    # the post-idle burst for whichever section runs first).  Both sections
+    # are warmed at their dispatch counts, then measured in alternating
+    # rounds with rep 0 discarded; steady-state median is the figure.
+    def run_of(m):
+        def run(n):
+            m.step(n)
+            float(jnp.sum(m.dd.get_curr(m.h)))  # force completion
+        return run
+
+    iters = 200 if full else 4
+    ex_iters = 100 if full else 4
+    reps = 6 if full else 2
+    runs, inners = [run_of(model)], [iters]
+    if ex_model is not None:
+        runs.append(run_of(ex_model))
+        inners.append(ex_iters)
+    for run, n in zip(runs, inners):
+        run(n)  # warm + compile at the timed static count
+    rounds = measure_alternating(runs, inners, rt, reps)
+    dt = _stats.median(rounds[0])
+    mcells_per_s = cells / dt / 1e6
+    if ex_model is not None:
+        ex_dt = _stats.median(rounds[1])
+        ex_mcells_per_s = round(cells / ex_dt / 1e6 / max(1, ndev), 1)  # per chip
+    else:
+        ex_mcells_per_s = None
 
     # free the jacobi models' HBM before the 8-field astaroth run (~6 GB)
     wrap_k = model._wrap_k
@@ -169,6 +232,12 @@ def main() -> None:
         # pushes this past 1.0
         "frac_of_chip_roofline": round(mcells_per_s / chip_roofline_mcells, 3),
         "temporal_k": wrap_k,
+        # the autotuner's decision for this workload: cache hit/miss, trials
+        # run (0 on a warm cache), pruned candidates, the winning config,
+        # and the search's steady-state numbers for winner vs static
+        # fallback (null on a warm cache — nothing was re-measured)
+        "tune": tune_json,
+        "measurement_protocol": "alternating_median_drop_rep0",
         "exchange_path_mcells_per_s_per_chip": ex_mcells_per_s,
         "exchange_path": ex_path,
         "exchange_path_devices": ndev,

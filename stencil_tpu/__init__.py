@@ -16,7 +16,16 @@ from stencil_tpu.core.dim3 import Dim3, Rect3
 from stencil_tpu.core.direction_map import DirectionMap, DIRECTIONS_26
 from stencil_tpu.core.radius import Radius
 from stencil_tpu.core.geometry import LocalSpec
-from stencil_tpu.utils.config import MethodFlags, PlacementStrategy
+from stencil_tpu.utils.config import (
+    MethodFlags,
+    PlacementStrategy,
+    apply_compile_cache,
+)
+
+# Persistent XLA compilation cache (STENCIL_COMPILE_CACHE_DIR): applied at
+# package import so it lands before the first backend compile whichever
+# entry point the process came through (models, drivers, bench.py).
+apply_compile_cache()
 
 __version__ = "0.1.0"
 

@@ -77,6 +77,71 @@ def add_telemetry_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def add_tune_flags(p: argparse.ArgumentParser) -> None:
+    """Autotuner knobs shared by the model drivers (docs/tuning.md):
+    ``--tune`` runs the on-device search for this driver's workload before
+    the model builds (zero trials when the persistent cache is warm),
+    ``--no-tune`` pins the static calibrated picks, ``--tune-cache``
+    redirects the persistent config cache for this run."""
+    g = p.add_mutually_exclusive_group()
+    g.add_argument(
+        "--tune",
+        action="store_true",
+        help="autotune this workload on-device first (cached: second run "
+        "does zero trials)",
+    )
+    g.add_argument(
+        "--no-tune",
+        action="store_true",
+        help="ignore tuned configs; use the static calibrated defaults",
+    )
+    p.add_argument(
+        "--tune-cache",
+        default=None,
+        metavar="DIR",
+        help="tuned-config cache dir (default: STENCIL_TUNE_CACHE or "
+        "~/.cache/stencil_tpu/tune)",
+    )
+
+
+def tune_begin(args) -> None:
+    """Apply the ``add_tune_flags`` choices to the tune facade; call right
+    after ``parse_args`` (before any model/planner construction).  Pair
+    with ``tune_end`` on the exit path — the overrides are process-global
+    and sequential in-process driver runs (tests) must not inherit a prior
+    run's ``--no-tune``/``--tune-cache``."""
+    from stencil_tpu import tune
+
+    args._tune_restore = tune.overrides()
+    if getattr(args, "tune_cache", None):
+        tune.set_cache_dir(args.tune_cache)
+    if getattr(args, "no_tune", False):
+        tune.set_enabled(False)
+    elif getattr(args, "tune", False):
+        tune.set_enabled(True)
+
+
+def tune_end(args) -> None:
+    from stencil_tpu import tune
+
+    state = getattr(args, "_tune_restore", None)
+    if state is not None:
+        tune.restore(state)
+        args._tune_restore = None
+
+
+def tune_report_stderr(report) -> None:
+    """One stderr line summarizing a driver's autotune outcome."""
+    import sys
+
+    print(
+        f"tune[{report.key.route}]: source={report.source} "
+        f"config={report.config} trials={report.trials} "
+        f"pruned={report.pruned}",
+        file=sys.stderr,
+    )
+
+
 def _write_snapshot(path: str) -> None:
     import json
 

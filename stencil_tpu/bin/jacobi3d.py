@@ -62,12 +62,23 @@ def main(argv=None) -> int:
         "achieved-overlap delta (reference --no-overlap A/B, jacobi3d.cu:265-337)",
     )
     _common.add_telemetry_flags(p)
+    _common.add_tune_flags(p)
     p.add_argument("x", type=int, nargs="?", default=512)
     p.add_argument("y", type=int, nargs="?", default=512)
     p.add_argument("z", type=int, nargs="?", default=512)
     args = p.parse_args(argv)
     _common.telemetry_begin(args)
+    _common.tune_begin(args)
+    try:
+        # the tune overrides are process-global; restore them whatever
+        # happens so sequential in-process runs (tests) never inherit a
+        # prior run's --no-tune/--tune-cache
+        return _run(args)
+    finally:
+        _common.tune_end(args)
 
+
+def _run(args) -> int:
     x, y, z = _global_size(args)
     if args.overlap_report:
         rc = _overlap_report(args, x, y, z)
@@ -86,6 +97,44 @@ def main(argv=None) -> int:
             "halo-multiplier/--no-overlap force --kernel-impl jnp", file=sys.stderr
         )
         kernel_impl = "jnp"
+    if (
+        args.tune
+        and kernel_impl == "pallas"
+        and args.pallas_path in ("auto", "wrap", "wavefront")
+    ):  # slab/shell routes have no tunable axes — nothing would consult
+        # populate the tuned-config cache for THIS workload before the model
+        # builds (the build consults it); a warm cache runs zero trials.
+        # Gated on the POST-force kernel_impl: a jnp run never consults the
+        # tuner, so searching for it would be pure wasted device work.
+        # Search selection follows the route the MODEL will take (the wrap
+        # route only exists single-device; auto picks wrap there and the
+        # wavefront otherwise) — searching a route the build won't consult
+        # would burn device work on an orphaned cache entry.
+        from stencil_tpu.tune import runners as tune_runners
+
+        interp = jax.default_backend() == "cpu"
+        single = len(jax.devices()) == 1
+        if args.pallas_path == "wrap" or (args.pallas_path == "auto" and single):
+            if not single:
+                print(
+                    "--tune skipped: pallas_path='wrap' needs a single "
+                    "device (the model build will reject it too)",
+                    file=sys.stderr,
+                )
+                report = None
+            else:
+                report = tune_runners.autotune_jacobi_wrap(
+                    x, y, z, dtype=jnp.dtype(args.dtype), interpret=interp
+                )
+        else:  # forced wavefront, or auto on a multi-device mesh
+            report = tune_runners.autotune_jacobi_wavefront(
+                x, y, z, dtype=jnp.dtype(args.dtype), interpret=interp,
+                # same placement as the model built below — a strategy
+                # mismatch would re-key the workload and orphan the search
+                strategy=_common.parse_strategy(args),
+            )
+        if report is not None:
+            _common.tune_report_stderr(report)
     model = Jacobi3D(
         x,
         y,

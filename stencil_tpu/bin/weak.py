@@ -75,6 +75,8 @@ def build_parser(name: str) -> argparse.ArgumentParser:
     p.add_argument("--naive", action="store_true", help="trivial placement (weak.cu --naive)")
     p.add_argument("--cuda-aware", dest="cuda_aware_mpi", action="store_true")
     p.add_argument("--staged", action="store_true")
+    # no tune flags here: weak/strong drive the raw exchange (no planner
+    # ever consults the autotuner), so --tune would be a misleading no-op
     _common.add_telemetry_flags(p)
     return p
 

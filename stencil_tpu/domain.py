@@ -185,11 +185,12 @@ class DistributedDomain:
         # blocking per-exchange timing costs a device sync per call, exactly
         # like the reference's barrier-per-call EXCHANGE_STATS (default OFF,
         # CMakeLists.txt:20); opt in via env or enable_exchange_stats().
-        self._exchange_stats = os.environ.get("STENCIL_EXCHANGE_STATS", "0") == "1"
+        from stencil_tpu.utils.config import env_bool, env_int
+
+        self._exchange_stats = env_bool("STENCIL_EXCHANGE_STATS", False)
         # resilience: divergence sentinel (off unless STENCIL_DIVERGENCE_EVERY
         # or set_divergence_check sets a cadence) + dispatch retry policy,
         # both lazily built on first run_step
-        from stencil_tpu.utils.config import env_int
 
         self._divergence_every = env_int("STENCIL_DIVERGENCE_EVERY", 0, minimum=0)
         self._sentinel = None
@@ -247,6 +248,41 @@ class DistributedDomain:
 
     def halo_multiplier(self) -> int:
         return self._halo_mult
+
+    def tune_key(self, route: str):
+        """The autotuner ``WorkloadKey`` for this domain under ``route`` —
+        THE one place the (chip kind, domain shape, dtype, n_fields, mesh
+        shape, radius, engine route) tuple is assembled, so every planner
+        consults the same cache entry.  Works pre-realize too: the mesh dim
+        is mirrored from the deterministic ``make_mesh`` computation (the
+        same mirror ``Jacobi3D._plan_wavefront`` relies on)."""
+        from stencil_tpu.tune.key import WorkloadKey, chip_kind
+
+        if self.placement is not None:
+            dim = self.placement.dim()
+        else:
+            devices = (
+                list(self._devices) if self._devices is not None else jax.devices()
+            )
+            _, placement = make_mesh(
+                self._size, self._radius, devices, self._strategy,
+                force_dim=self._force_dim,
+            )
+            dim = placement.dim()
+        r = self._radius
+        rmax = max(
+            r.lo().x, r.lo().y, r.lo().z, r.hi().x, r.hi().y, r.hi().z
+        )
+        dtypes = ",".join(sorted({h.dtype.name for h in self._handles}))
+        return WorkloadKey(
+            chip=chip_kind(),
+            domain=(self._size.x, self._size.y, self._size.z),
+            dtype=dtypes or "float32",
+            n_fields=max(len(self._handles), 1),
+            mesh=(dim.x, dim.y, dim.z),
+            radius=rmax,
+            route=route,
+        )
 
     def size(self) -> Dim3:
         return self._size

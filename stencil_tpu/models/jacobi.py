@@ -47,6 +47,13 @@ class Jacobi3D:
         pallas_path: str = "auto",  # "auto"|"wrap"|"slab"|"shell"|"wavefront"
         check_divergence_every: int = 0,  # divergence sentinel cadence
         # (resilience/sentinel.py); 0 = off
+        wavefront_alias: bool = None,  # input_output_aliases on the wavefront
+        # kernels: None = env (STENCIL_WAVEFRONT_ALIAS) > tuned config >
+        # un-aliased static default; the autotuner's candidate builds set it
+        # explicitly
+        z_ring: bool = None,  # z-RING vs padded layout preference: None =
+        # env (STENCIL_Z_RING) > tuned config > ring default; structural
+        # gates (lane alignment, slab mode) still apply either way
     ):
         self.dd = DistributedDomain(x, y, z)
         # radius 1 on faces only (jacobi3d.cu:205-214)
@@ -65,8 +72,12 @@ class Jacobi3D:
         if pallas_path not in ("auto", "wrap", "slab", "shell", "wavefront"):
             raise ValueError(f"unknown pallas_path {pallas_path!r}")
         self.pallas_path_request = pallas_path
+        self.wavefront_alias_request = wavefront_alias
+        self.z_ring_request = z_ring
         if check_divergence_every:
             self.dd.set_divergence_check(check_divergence_every)
+        # tuned config applied by _plan_wavefront (auto mode only)
+        self._tuned_wavefront = None
         self._step = None
         self._ladder = None  # degradation ladder, built at realize()
         # fast paths (wrap/slab kernels) advance interiors only; the carried
@@ -164,6 +175,11 @@ class Jacobi3D:
             )
         n_min = min(min(n), min(v))
         itemsize = self.h.dtype.itemsize
+        # planning diagnostics for the autotuner's candidate-space builder
+        # (tune/runners.autotune_jacobi_wavefront)
+        self._wavefront_plan_info = {
+            "n": tuple(n), "valid": tuple(v), "padded": padded, "n_min": n_min,
+        }
 
         def fits(m, z):
             return wavefront_vmem_fits(
@@ -179,6 +195,25 @@ class Jacobi3D:
             warn_if_over_vmem_budget(m, n[1] + 2 * m, n[2] + 2 * m, itemsize)
             self._wavefront_z_planned = fits(m, True) and not padded
             return m
+        # the autotuner's persisted on-device measurement beats the static
+        # model below (docs/tuning.md); only structural bounds are
+        # re-checked — a tuned m may exceed the shell-traffic heuristic cap,
+        # that is the point of measuring
+        from stencil_tpu import tune
+
+        cfg = tune.best_config(dd.tune_key("jacobi-wavefront"))
+        if cfg is not None:
+            m = cfg.get("m")
+            if isinstance(m, int) and 1 <= m <= n_min:
+                self._tuned_wavefront = cfg
+                self._wavefront_z_planned = fits(m, True) and not padded
+                return m
+            from stencil_tpu.utils.logging import log_warn
+
+            log_warn(
+                f"tuned config {cfg} for jacobi-wavefront is structurally "
+                f"invalid here (need 1 <= m <= {n_min}); using the static plan"
+            )
         # n_min//4 caps the redundant shell traffic: a depth-m macro step
         # exchanges ~6*m*n^2 extra cells against m*n^3 of compute, so keep
         # the shell a small fraction of the shard
@@ -211,7 +246,6 @@ class Jacobi3D:
         neighbors and then planes from the x neighbors (two hops carry the
         xyz-corner cells from the diagonal blocks), mirroring the sweep
         order of the in-array exchange."""
-        import os
         from functools import partial
 
         import jax
@@ -250,9 +284,11 @@ class Jacobi3D:
         raw = dd.local_spec().raw_size()
         interpret = self.interpret
         name = self.h.name
-        z_slab_mode = (
-            os.environ.get("STENCIL_Z_SLABS", "1") != "0"
-            and getattr(self, "_wavefront_z_planned", False)
+        from stencil_tpu.utils.config import env_bool
+
+        tuned = self._tuned_wavefront or {}
+        z_slab_mode = env_bool("STENCIL_Z_SLABS", True) and getattr(
+            self, "_wavefront_z_planned", False
         )
         # In-place aliasing serializes the deep-m pipeline (probe21b, 512^3:
         # m=16 aliased 84k vs un-aliased 102k Mcells/s) — default to a fresh
@@ -260,9 +296,19 @@ class Jacobi3D:
         # The un-aliased kernel leaves high-x shell planes UNINITIALIZED;
         # every consumer (next macro's exchange, stale-shell readback)
         # rewrites the shell before reading it, so no garbage escapes.
-        # STENCIL_WAVEFRONT_ALIAS=1 restores the in-place form for
-        # memory-tight domains.
-        alias = os.environ.get("STENCIL_WAVEFRONT_ALIAS", "0") == "1"
+        # Precedence: constructor request (autotuner candidate builds) >
+        # STENCIL_WAVEFRONT_ALIAS (validated read) > the tuned config for
+        # this workload > the un-aliased static default above.
+        if self.wavefront_alias_request is not None:
+            alias = bool(self.wavefront_alias_request)
+        else:
+            env_alias = env_bool("STENCIL_WAVEFRONT_ALIAS", None)
+            if env_alias is not None:
+                alias = env_alias
+            elif tuned.get("alias") is not None:
+                alias = bool(tuned["alias"])
+            else:
+                alias = False
         self._marks_shell_stale = True
         self._pallas_path = "wavefront"
         self._wavefront_z_slabs = z_slab_mode
@@ -273,11 +319,24 @@ class Jacobi3D:
         # periodic-consistent (jacobi_zring_wavefront_step) — cutting the
         # streamed bytes by the whole z pad share (~20% at 512^3 m=16,
         # probe24/25).  STENCIL_Z_RING=0 restores the padded layout.
+        # ring preference: constructor request > STENCIL_Z_RING (validated
+        # read) > the tuned config's measured layout pick > ring by default
+        # (probe25d: neutral wall-clock on v5e, smaller footprint)
+        if self.z_ring_request is not None:
+            ring_pref = bool(self.z_ring_request)
+        else:
+            env_ring = env_bool("STENCIL_Z_RING", None)
+            if env_ring is not None:
+                ring_pref = env_ring
+            elif tuned.get("z_ring") is not None:
+                ring_pref = bool(tuned["z_ring"])
+            else:
+                ring_pref = True
         z_ring_mode = (
             z_slab_mode
             and n.z % 128 == 0
             and 2 * m <= _ZRING_OFF
-            and os.environ.get("STENCIL_Z_RING", "1") != "0"
+            and ring_pref
         )
         self._wavefront_z_ring = z_ring_mode
         # Ragged lane extents cripple the plane DMA (probe22: 512^2x516
@@ -480,7 +539,8 @@ class Jacobi3D:
             self._marks_shell_stale = True
             self._pallas_path = "wrap"
             k = choose_temporal_k(
-                (n.x, n.y, n.z), self.h.dtype.itemsize, self.temporal_k
+                (n.x, n.y, n.z), self.h.dtype.itemsize, self.temporal_k,
+                tune_key=dd.tune_key("jacobi-wrap"),
             )
             self._wrap_k = k
 

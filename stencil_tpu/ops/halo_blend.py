@@ -39,7 +39,9 @@ def enabled() -> bool:
     tunnels) takes the plain-DUS path it has actually been validated on.  Env
     override ``STENCIL_HALO_BLEND=0|1`` forces either path (tests force 1
     with interpret mode to pin blend semantics against DUS)."""
-    env = os.environ.get("STENCIL_HALO_BLEND", "auto")
+    from stencil_tpu.utils.config import env_choice
+
+    env = env_choice("STENCIL_HALO_BLEND", "auto", ("auto", "0", "1"))
     if env == "0":
         return False
     if env == "1":

@@ -175,11 +175,20 @@ def warn_if_over_vmem_budget(k: int, plane_y: int, plane_z: int, itemsize: int) 
         )
 
 
-def choose_temporal_k(shape: Tuple[int, int, int], itemsize: int, requested="auto") -> int:
+def choose_temporal_k(
+    shape: Tuple[int, int, int], itemsize: int, requested="auto", tune_key=None
+) -> int:
     """Pick the wrap kernel's temporal blocking depth: the deepest k whose
     VMEM footprint fits the calibrated budget (``auto``), or a validated
     explicit int.  Measured sweep (scripts/probe10b, v5e f32): 512^3
-    41 -> 94 Gcells/s (k=3), 384^3 -> 120 (k=6), 256^3 -> 134 (k=6)."""
+    41 -> 94 Gcells/s (k=3), 384^3 -> 120 (k=6), 256^3 -> 134 (k=6).
+
+    ``tune_key`` (a ``tune.WorkloadKey``) consults the measurement-driven
+    autotuner first: a persisted on-device-measured depth for this
+    chip/shape/dtype wins over the static model below (which is the v5e
+    calibration, kept as the no-tune/cold-cache fallback — docs/tuning.md).
+    A tuned depth may legitimately exceed ``_WRAP_MAX_K``: the plateau is a
+    property of the probed chip, not the kernel."""
     X, Y, Z = shape
     if requested != "auto":
         k = int(requested)
@@ -187,6 +196,21 @@ def choose_temporal_k(shape: Tuple[int, int, int], itemsize: int, requested="aut
             raise ValueError(f"temporal_k={k} needs 1 <= k <= X//2 = {X // 2}")
         warn_if_over_vmem_budget(k, Y, Z, itemsize)
         return k
+    if tune_key is not None:
+        from stencil_tpu import tune
+
+        cfg = tune.best_config(tune_key)
+        if cfg is not None:
+            k = cfg.get("k")
+            if isinstance(k, int) and 1 <= k <= max(1, X // 2):
+                return k
+            from stencil_tpu.utils.logging import log_warn
+
+            log_warn(
+                f"tuned config {cfg} for {tune_key.label()} is structurally "
+                f"invalid here (need 1 <= k <= {max(1, X // 2)}); using the "
+                "static pick"
+            )
     k = 1
     for cand in range(2, _WRAP_MAX_K + 1):
         if cand <= X // 2 and wavefront_vmem_fits(cand, Y, Z, itemsize):

@@ -460,6 +460,67 @@ def stream_vmem_fits(
     return est + _VMEM_STACK_MARGIN * len(itemsizes) <= _vmem_budget()
 
 
+def _tuned_stream_plan(dd, x_radius: int, separable: bool) -> dict:
+    """A structurally VALID persisted plan for this domain from the
+    autotuner, or None.  Validity is re-checked here (not trusted from the
+    file): the cache key pins chip/shape/dtype/mesh/radius/route, but a
+    hand-edited or cross-version file must degrade to the static plan, not
+    crash the build."""
+    from stencil_tpu import tune
+
+    cfg = tune.best_config(dd.tune_key("stream"))
+    if cfg is None:
+        return None
+    route = cfg.get("route")
+    m = cfg.get("m")
+    plan = {
+        "route": route,
+        "m": m,
+        "z_slabs": bool(cfg.get("z_slabs", False)),
+        "grouping": cfg.get("grouping", "joint"),
+    }
+    if cfg.get("alias") is not None:
+        plan["alias"] = bool(cfg["alias"])
+    n = dd.local_spec().sz
+    shell = dd._shell_radius
+    lo, hi = shell.lo(), shell.hi()
+    padded = any(v is not None for v in dd._valid_last)
+    ok = isinstance(m, int) and m >= 1
+    if ok and plan["grouping"] == "per-field":
+        ok = separable and len(dd._handles) > 1
+    elif ok and plan["grouping"] != "joint":
+        ok = False
+    if ok and route == "wrap":
+        ok = dd.num_subdomains() == 1 and x_radius == 1 and m <= n.x // 2
+    elif ok and route == "wavefront":
+        uniform = len({lo.x, lo.y, lo.z, hi.x, hi.y, hi.z}) == 1
+        v_min = min(
+            (dd._valid_last[ax] if dd._valid_last[ax] is not None else n[ax])
+            for ax in range(3)
+        )
+        ok = (
+            x_radius == 1
+            and uniform
+            and lo.x >= 2
+            and 2 <= m <= min(lo.x, v_min)
+            and not (plan["z_slabs"] and padded)
+        )
+    elif ok and route == "plane":
+        ok = m == 1 and not plan["z_slabs"]
+    elif ok:
+        ok = False
+    if not ok:
+        from stencil_tpu.utils.logging import log_warn
+
+        log_warn(
+            f"tuned stream config {cfg} is structurally invalid for this "
+            "domain (shell/shards changed since it was measured?); using "
+            "the static plan"
+        )
+        return None
+    return plan
+
+
 def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
                 max_m: int = None) -> dict:
     """Route planning for ``make_stream_step`` on a REALIZED domain.
@@ -504,6 +565,15 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
         raise ValueError("the streaming engine does not support N-D component data")
     if path not in ("auto", "plane", "wavefront", "wrap"):
         raise ValueError(f"unknown stream path {path!r}")
+    # the autotuner's persisted pick wins over the static model below, but
+    # only on the unconstrained auto path: a forced route is an explicit
+    # request, and a depth cap (user stream_depth / the ladder's compile-
+    # failure step-down) must re-plan statically under the cap rather than
+    # re-apply the tuned depth that just failed
+    if path == "auto" and max_m is None:
+        tuned = _tuned_stream_plan(dd, x_radius, separable)
+        if tuned is not None:
+            return tuned
     padded = any(v is not None for v in dd._valid_last)
     shell = dd._shell_radius
     lo, hi = shell.lo(), shell.hi()
@@ -661,6 +731,24 @@ def permute_and_extend_z_slabs(zout, s: int, mesh_shape, yext, xext):
     return jnp.concatenate([xext(yext(zlo)), xext(yext(zhi))], axis=1)
 
 
+def _resolve_stream_alias(plan: dict, n_fields: int) -> bool:
+    """input_output_aliases decision for a stream plan.  Precedence mirrors
+    the bespoke wavefront path (models/jacobi.py): an autotuner CANDIDATE
+    build (``alias_forced`` — its A/B trials must actually differ, whatever
+    the environment says) > ``STENCIL_STREAM_ALIAS=0/1`` (validated read) >
+    the plan's persisted tuned ``alias`` > the >= 4-fields static rule."""
+    from stencil_tpu.utils.config import env_choice
+
+    if plan.get("alias_forced") and plan.get("alias") is not None:
+        return bool(plan["alias"])
+    env = env_choice("STENCIL_STREAM_ALIAS", "auto", ("auto", "0", "1"))
+    if env != "auto":
+        return env == "1"
+    if plan.get("alias") is not None:
+        return bool(plan["alias"])
+    return n_fields >= 4
+
+
 def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
     from jax.sharding import PartitionSpec as P
 
@@ -690,11 +778,7 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
     # bench), and even per-field grouped passes measured ~50% SLOWER
     # un-aliased at 8x512^3 (19.1 vs 12.8 ms/iter, r5 bench) — the per-pass
     # allocate/free churn costs more than the aliasing serialization saves.
-    # STENCIL_STREAM_ALIAS=0/1 overrides.
-    import os as _os
-
-    _alias_env = _os.environ.get("STENCIL_STREAM_ALIAS", "auto")
-    alias = len(names) >= 4 if _alias_env == "auto" else _alias_env == "1"
+    alias = _resolve_stream_alias(plan, len(names))
 
     def origin_of():
         # NOTE: must be called INSIDE the fori_loop body that consumes it.

@@ -16,7 +16,20 @@ from typing import List, Tuple
 
 import numpy as np
 
-if os.environ.get("STENCIL_NATIVE", "1") == "0":
+from stencil_tpu.utils.config import env_bool
+
+try:
+    _native_enabled = env_bool("STENCIL_NATIVE", True)
+except ValueError as e:
+    # module-import-time read, lazily triggered from qap.solve_auto whose
+    # fallback guard catches ImportError/OSError only: a malformed value
+    # must warn-and-default (the STENCIL_OUTPUT_LEVEL convention), not
+    # abort placement planning with an escaping ValueError
+    from stencil_tpu.utils.logging import log_warn
+
+    log_warn(f"{e}; treating STENCIL_NATIVE as enabled")
+    _native_enabled = True
+if not _native_enabled:
     raise ImportError("native disabled via STENCIL_NATIVE=0")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")

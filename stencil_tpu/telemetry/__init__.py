@@ -67,9 +67,9 @@ class _Telemetry:
         self._configured = False
 
     def configure_from_env(self) -> None:
-        from stencil_tpu.utils.config import env_bool
+        from stencil_tpu.utils.config import env_bool, env_str
 
-        out_dir = os.environ.get("STENCIL_TELEMETRY_DIR") or None
+        out_dir = env_str("STENCIL_TELEMETRY_DIR", None)
         enabled = env_bool("STENCIL_TELEMETRY", out_dir is not None)
         events = env_bool("STENCIL_TELEMETRY_EVENTS", out_dir is not None)
         if events and out_dir is None and "STENCIL_TELEMETRY_EVENTS" in os.environ:

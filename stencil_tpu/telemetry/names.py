@@ -39,6 +39,18 @@ LADDER_DESCENTS = "resilience.ladder.descents"
 FAULTS_INJECTED = "resilience.faults.injected"
 #: divergence-sentinel NaN/Inf detections (resilience/sentinel.py)
 SENTINEL_TRIPS = "resilience.sentinel.trips"
+#: tuned-config cache consultations that found a persisted config (tune/)
+TUNE_CACHE_HIT = "tune.cache.hit"
+#: consultations that found nothing (cold cache, stale version, corrupt file)
+TUNE_CACHE_MISS = "tune.cache.miss"
+#: candidate configs actually measured by the autotuner's trial protocol
+TUNE_TRIALS = "tune.trials"
+#: candidates pruned without a steady-state measurement (VMEM model
+#: pre-filter, or an on-device VMEM_OOM/COMPILE_REJECT pruning the candidate
+#: and its deeper neighbors)
+TUNE_PRUNED = "tune.pruned"
+#: winning configs selected (and persisted) by a completed search
+TUNE_SELECTED = "tune.selected"
 
 ALL_COUNTERS = frozenset({
     EXCHANGE_COUNT,
@@ -51,6 +63,11 @@ ALL_COUNTERS = frozenset({
     LADDER_DESCENTS,
     FAULTS_INJECTED,
     SENTINEL_TRIPS,
+    TUNE_CACHE_HIT,
+    TUNE_CACHE_MISS,
+    TUNE_TRIALS,
+    TUNE_PRUNED,
+    TUNE_SELECTED,
 })
 
 # --- gauges (last-value) -----------------------------------------------------
@@ -108,6 +125,12 @@ EVENT_DESCENT = "resilience.descent"
 EVENT_FAULT = "resilience.fault_injected"
 #: the divergence sentinel tripped (fields: quantity, step)
 EVENT_DIVERGENCE = "resilience.divergence"
+#: a tuning decision (fields: key, source=cache|search|static, config,
+#: trials, pruned)
+EVENT_TUNE_DECISION = "tune.decision"
+#: one autotuner trial finished (fields: key, candidate, seconds_per_iter —
+#: or failure_class/error when the candidate was pruned)
+EVENT_TUNE_TRIAL = "tune.trial"
 
 ALL_EVENTS = frozenset({
     EVENT_COMPILE,
@@ -117,6 +140,8 @@ ALL_EVENTS = frozenset({
     EVENT_DESCENT,
     EVENT_FAULT,
     EVENT_DIVERGENCE,
+    EVENT_TUNE_DECISION,
+    EVENT_TUNE_TRIAL,
 })
 
 #: every registered name, any kind — what the lint checks literals against

@@ -1,0 +1,194 @@
+"""Measurement-driven autotuner with a persistent config cache.
+
+The fast paths were calibrated on ONE v5e and frozen in code (``_WRAP_MAX_K
+= 16``, the VMEM-model depth picks, alias/z-ring defaults, route selection).
+PERF_NOTES.md documents the k-plateau spanning ~12-24 under heavy contention
+noise and says to re-qualify the constants per toolchain/chip generation —
+this package is the way to do that:
+
+* ``best_config(key)`` — THE consult entry point.  Every fast-path planner
+  (``choose_temporal_k``, ``plan_stream``, ``Jacobi3D._plan_wavefront``)
+  asks it for the workload's persisted config and falls back to the static
+  calibrated pick on a miss.  Zero trials, zero jax work: a cache hit is a
+  file read (memoized per process).
+* ``ensure(key, candidates, build_run, ...)`` — consult-or-search: on a
+  cache miss, run the burst-aware trial protocol (``trial.py``) over the
+  candidate space (``space.py``) and persist the winner, so the SECOND run
+  does zero trials.
+* ``runners`` — concrete searches for the shipped workloads
+  (``autotune_jacobi_wrap``, ``autotune_jacobi_wavefront``,
+  ``autotune_stream``), invoked by ``bench.py`` and the ``--tune`` driver
+  flag.
+
+Knobs (validated reads, ``utils/config.py``):
+
+* ``STENCIL_TUNE=0``        — ignore tuned configs entirely (static picks)
+* ``STENCIL_TUNE_CACHE=D``  — cache directory (default
+  ``~/.cache/stencil_tpu/tune``); ``--tune-cache`` overrides per run
+
+Every decision is telemetry (``tune.cache.hit/miss``, ``tune.trials``,
+``tune.pruned``, ``tune.selected`` counters; ``tune.decision`` /
+``tune.trial`` events) — see docs/tuning.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, List, Optional
+
+from stencil_tpu import telemetry
+from stencil_tpu.telemetry import names as tm
+from stencil_tpu.tune import cache as _cache
+from stencil_tpu.tune.key import WorkloadKey, chip_kind  # noqa: F401 (re-export)
+from stencil_tpu.tune.trial import TrialResult, TuneReport, search  # noqa: F401
+
+#: process-local enable override (driver --tune/--no-tune); None = env
+_enabled_override: Optional[bool] = None
+
+#: memoized consults: (cache_dir, key.digest()) -> config dict or None
+_memo: dict = {}
+
+
+def enabled() -> bool:
+    """Is tuned-config consultation on?  ``STENCIL_TUNE=0`` (or a driver's
+    ``--no-tune``) turns every ``best_config`` into a miss-without-counting,
+    i.e. the static calibrated picks."""
+    if _enabled_override is not None:
+        return _enabled_override
+    from stencil_tpu.utils.config import env_bool
+
+    return env_bool("STENCIL_TUNE", True)
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Process-local override (``--tune``/``--no-tune``); None restores the
+    env-driven default."""
+    global _enabled_override
+    _enabled_override = value
+
+
+@contextlib.contextmanager
+def disabled():
+    """Scoped consult-off — the runners use it to compute the STATIC pick
+    (the fallback a search must defend) without reading their own cache."""
+    prev = _enabled_override
+    set_enabled(False)
+    try:
+        yield
+    finally:
+        set_enabled(prev)
+
+
+def set_cache_dir(path: Optional[str]) -> None:
+    """Per-run cache-dir override (driver ``--tune-cache``)."""
+    _cache.set_dir_override(path)
+    _memo.clear()
+
+
+def overrides():
+    """Opaque snapshot of the process-local overrides — drivers save it in
+    ``tune_begin`` and hand it back to ``restore`` in ``tune_end`` so
+    sequential in-process runs (tests) don't leak ``--no-tune`` state."""
+    return (_enabled_override, _cache._dir_override)
+
+
+def restore(state) -> None:
+    set_enabled(state[0])
+    set_cache_dir(state[1])
+
+
+def reset_memo() -> None:
+    """Drop the per-process consult memo (tests that rewrite cache files)."""
+    _memo.clear()
+
+
+def best_config(key: WorkloadKey) -> Optional[dict]:
+    """The persisted config for ``key``, or None (caller falls back to its
+    static pick).  Counts ``tune.cache.hit``/``tune.cache.miss`` per consult;
+    disabled tuning returns None without counting (the fallback is a
+    decision, not a miss)."""
+    if not enabled():
+        return None
+    memo_key = (_cache.cache_dir(), key.digest())
+    if memo_key in _memo:
+        cfg = _memo[memo_key]
+    else:
+        loaded = _cache.load(key)
+        cfg = loaded[0] if loaded is not None else None
+        _memo[memo_key] = cfg
+    if cfg is None:
+        telemetry.inc(tm.TUNE_CACHE_MISS)
+        return None
+    telemetry.inc(tm.TUNE_CACHE_HIT)
+    return dict(cfg)
+
+
+def record_config(key: WorkloadKey, config: dict, meta: Optional[dict] = None) -> str:
+    """Persist ``config`` as the tuned pick for ``key`` (and update the
+    consult memo so this process sees it immediately)."""
+    path = _cache.store(key, config, meta)
+    _memo[(_cache.cache_dir(), key.digest())] = dict(config)
+    return path
+
+
+def ensure(
+    key: WorkloadKey,
+    candidates: List[dict],
+    build_run: Callable[[dict], Callable[[int], None]],
+    *,
+    depth_key: Optional[str] = None,
+    static: Optional[dict] = None,
+    reps: int = 3,
+    rt: Optional[float] = None,
+    prefiltered: int = 0,
+) -> TuneReport:
+    """Consult-or-search: a warm cache returns immediately with zero trials;
+    otherwise run the burst-aware search over ``candidates`` and persist the
+    winner.  When every candidate is pruned, the report carries ``static``
+    (source ``"static"``) — tuning never crashes a run the fallback could
+    have served."""
+    cached = best_config(key)
+    if cached is not None:
+        report = TuneReport(key=key, source="cache", config=cached, static_config=static)
+        report.cache_path = _cache.path_for(key)
+        telemetry.emit_event(
+            tm.EVENT_TUNE_DECISION,
+            key=key.label(),
+            source="cache",
+            config=cached,
+            trials=0,
+            pruned=0,
+        )
+        return report
+    if not enabled():
+        return TuneReport(key=key, source="static", config=static, static_config=static)
+    report = search(
+        key,
+        candidates,
+        build_run,
+        depth_key=depth_key,
+        reps=reps,
+        rt=rt,
+        prefiltered=prefiltered,
+    )
+    report.static_config = static
+    if report.config is not None:
+        meta = {
+            "trials": report.trials,
+            "pruned": report.pruned,
+            "results": report.to_json()["results"],
+        }
+        report.cache_path = record_config(key, report.config, meta)
+        telemetry.inc(tm.TUNE_SELECTED)
+    else:
+        report.source = "static"
+        report.config = static
+    telemetry.emit_event(
+        tm.EVENT_TUNE_DECISION,
+        key=key.label(),
+        source=report.source,
+        config=report.config,
+        trials=report.trials,
+        pruned=report.pruned,
+    )
+    return report

@@ -1,0 +1,254 @@
+"""Concrete autotune searches for the shipped workloads.
+
+Each runner builds the workload key, computes the STATIC pick under
+``tune.disabled()`` (the fallback a search must beat — never its own cached
+result), generates the candidate space, and hands ``tune.ensure`` a
+``build_run`` that compiles/executes the candidate on the device under the
+burst-aware protocol.  All candidate state (models, buffers) stays alive for
+the whole search — the alternating rounds require every candidate resident
+in one process (PERF_NOTES "Measurement discipline").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+from stencil_tpu import tune
+from stencil_tpu.tune import space
+from stencil_tpu.tune.key import WorkloadKey, chip_kind
+from stencil_tpu.tune.trial import TuneReport
+
+
+def _force_done(arr) -> None:
+    """Tunnel-honest completion: a 1-element readback of the first
+    addressable shard (block_until_ready returns early through axon)."""
+    import jax
+
+    shard = arr.addressable_shards[0].data
+    jax.device_get(shard[(slice(0, 1),) * shard.ndim])
+
+
+def autotune_jacobi_wrap(
+    x: int,
+    y: int,
+    z: int,
+    dtype=None,
+    interpret: bool = False,
+    reps: int = 3,
+    ks=None,
+    rt: Optional[float] = None,
+) -> TuneReport:
+    """Tune the single-device wrap kernel's temporal depth ``k`` for this
+    chip/shape/dtype.  Candidates span the measured plateau grid plus the
+    static ``choose_temporal_k`` pick; a Mosaic VMEM_OOM prunes the failing
+    depth and everything deeper."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from stencil_tpu.ops.jacobi_pallas import choose_temporal_k, jacobi_wrap_step
+
+    dtype = jnp.dtype(dtype or jnp.float32)
+    key = WorkloadKey(
+        chip=chip_kind(),
+        domain=(x, y, z),
+        dtype=dtype.name,
+        n_fields=1,
+        mesh=(1, 1, 1),
+        radius=1,
+        route="jacobi-wrap",
+    )
+    with tune.disabled():
+        static_k = choose_temporal_k((x, y, z), dtype.itemsize)
+    candidates, prefiltered = space.jacobi_wrap_space(
+        (x, y, z), dtype.itemsize, static_k, ks=ks
+    )
+    # the trial buffer allocates lazily at the FIRST candidate build: a
+    # warm-cache call must not touch device memory at all
+    state = {}
+
+    def build_run(cand):
+        if "block" not in state:
+            state["block"] = jnp.full((x, y, z), 0.5, dtype)
+        block = state["block"]
+        k = cand["k"]
+
+        @partial(jax.jit, static_argnums=1)
+        def steps(b, n):
+            blocked, rem = divmod(n, k)
+            if blocked:
+                b = lax.fori_loop(
+                    0,
+                    blocked,
+                    lambda _, bb: jacobi_wrap_step(bb, interpret=interpret, k=k),
+                    b,
+                )
+            if rem:
+                b = jacobi_wrap_step(b, interpret=interpret, k=rem)
+            return b
+
+        def run(n):
+            _force_done(steps(block, n))
+
+        return run
+
+    return tune.ensure(
+        key,
+        candidates,
+        build_run,
+        depth_key="k",
+        static={"k": static_k},
+        reps=reps,
+        rt=rt,
+        prefiltered=prefiltered,
+    )
+
+
+def autotune_jacobi_wavefront(
+    x: int,
+    y: int,
+    z: int,
+    dtype=None,
+    devices=None,
+    interpret: bool = False,
+    reps: int = 3,
+    ms=None,
+    rt: Optional[float] = None,
+    strategy=None,  # placement strategy — MUST match the model the caller
+    # will build (a different strategy can place a different mesh, which
+    # re-keys the workload and orphans the search's cache entry)
+) -> TuneReport:
+    """Tune the multi-device jacobi wavefront: depth ``m`` (== the halo
+    multiplier), ``input_output_aliases`` on/off, and the z-ring vs padded
+    layout.  Each candidate is a fully realized ``Jacobi3D`` — expensive by
+    design (this is the re-qualification pass), cached so it runs once per
+    workload/toolchain."""
+    import jax
+    import jax.numpy as jnp
+
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    dtype = jnp.dtype(dtype or jnp.float32)
+
+    def make_model(temporal_k="auto", alias=None, z_ring=None):
+        kwargs = {} if strategy is None else {"strategy": strategy}
+        return Jacobi3D(
+            x,
+            y,
+            z,
+            devices=devices,
+            dtype=dtype,
+            kernel_impl="pallas",
+            pallas_path="wavefront",
+            temporal_k=temporal_k,
+            interpret=interpret,
+            wavefront_alias=alias,
+            z_ring=z_ring,
+            **kwargs,
+        )
+
+    probe = make_model()
+    key = probe.dd.tune_key("jacobi-wavefront")
+    with tune.disabled():
+        static_m = probe._plan_wavefront()  # stashes _wavefront_plan_info
+    info = probe._wavefront_plan_info
+    # z-ring needs z-slab mode plus a lane-aligned shard z interior
+    z_ring_eligible = (
+        getattr(probe, "_wavefront_z_planned", False)
+        and info["n"][2] % 128 == 0
+    )
+    candidates, prefiltered = space.jacobi_wavefront_space(
+        static_m,
+        # structural caps only (a shard must fill an m-wide halo from valid
+        # cells, and the kernel's periodic-coordinate rem needs 2m < the
+        # global extent) — deeper than the static shell-traffic heuristic
+        # is allowed, measuring past it is the point
+        depth_cap=min(info["n_min"], (min(x, y, z) - 1) // 2),
+        z_ring_eligible=z_ring_eligible,
+        static_z_ring=True,
+        ms=ms,
+    )
+    models = {}
+
+    def build_run(cand):
+        model = make_model(
+            temporal_k=cand["m"], alias=cand["alias"], z_ring=cand.get("z_ring")
+        )
+        model.realize()
+        models[space.candidate_label(cand)] = model  # keep resident
+
+        def run(n):
+            model.step(n)
+            model.block_until_ready()
+
+        return run
+
+    report = tune.ensure(
+        key,
+        candidates,
+        build_run,
+        depth_key="m",
+        static={
+            "m": static_m,
+            "halo_multiplier": static_m,
+            "alias": False,
+            "z_ring": z_ring_eligible,
+        },
+        reps=reps,
+        rt=rt,
+        prefiltered=prefiltered,
+    )
+    models.clear()  # free candidate HBM before the caller builds the real model
+    return report
+
+
+def autotune_stream(
+    dd,
+    kernel,
+    x_radius: int = 1,
+    separable: bool = False,
+    interpret: bool = False,
+    reps: int = 3,
+    rt: Optional[float] = None,
+) -> TuneReport:
+    """Tune the generic stream engine's plan (route, depth, alias) for a
+    REALIZED domain + user kernel.  Trials run non-donating steps over the
+    domain's live buffers (the domain state is never advanced), so the
+    tuned plan feeds the very next ``make_step(engine="stream")`` on the
+    same process via the cache."""
+    from stencil_tpu.ops.stream import _build_stream_step, plan_stream
+
+    key = dd.tune_key("stream")
+    with tune.disabled():
+        static_plan = plan_stream(dd, x_radius, "auto", separable)
+    candidates, prefiltered = space.stream_space(dd, x_radius, separable, static_plan)
+
+    def build_run(cand):
+        plan = dict(cand)
+        plan.pop("halo_multiplier", None)
+        if "alias" in plan:
+            # candidate builds must be forcible — the alias A/B has to
+            # compile two DIFFERENT kernels even under STENCIL_STREAM_ALIAS
+            # (the marker stays out of the persisted config: `cand` wins)
+            plan["alias_forced"] = True
+        step = _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=False)
+
+        def run(n):
+            out = step(dd._curr, n)
+            _force_done(next(iter(out.values())))
+
+        return run
+
+    static = dict(static_plan)
+    static.setdefault("halo_multiplier", static.get("m", 1))
+    return tune.ensure(
+        key,
+        candidates,
+        build_run,
+        depth_key="m",
+        static=static,
+        reps=reps,
+        rt=rt,
+        prefiltered=prefiltered,
+    )

@@ -1,0 +1,179 @@
+"""Discrete candidate spaces for the autotuner.
+
+Axes (ISSUE: the constants PERF_NOTES.md says to re-qualify per chip):
+
+* **temporal depth** ``k`` (wrap) / ``m`` (wavefront) — the HBM-traffic
+  lever (~8/k B/cell/iter); the static default ``_WRAP_MAX_K = 16`` sits
+  mid-plateau on the one v5e the probes ran on.
+* **input_output_aliases on/off** — aliasing serializes the deep pipeline
+  (probe21b) but halves the working set; the crossover is chip-dependent.
+* **z-ring vs padded layout** — measured NEUTRAL on the probe chip (the
+  pipeline is VPU-bound there); a faster-VPU generation flips it.
+* **stream route** (wrap/plane/wavefront) and grouping — the generic
+  engine's plan axes.
+* **halo multiplier** — for the temporally-blocked paths the multiplier IS
+  the wavefront depth (the m-wide shell is exchanged every m steps), so the
+  ``m`` axis covers it; candidate dicts carry ``halo_multiplier == m`` to
+  make that explicit in persisted configs.
+
+Every space includes the CURRENT STATIC PICK as a candidate, so the search
+winner is never worse than the no-tune fallback under the same protocol.
+Candidates the VMEM model already excludes are returned separately
+(``prefiltered``) — they count into the ``tune.pruned`` telemetry without
+burning a trial.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+def candidate_label(cand: dict) -> str:
+    """Stable short label for logs / fault-plan targeting, e.g.
+    ``alias=0/k=8``.  ``/``-separated, NOT commas: ``STENCIL_FAULT_PLAN``
+    splits its entry list on commas, and these labels must be targetable."""
+    parts = []
+    for k in sorted(cand):
+        v = cand[k]
+        if isinstance(v, bool):
+            v = int(v)
+        parts.append(f"{k}={v}")
+    return "/".join(parts)
+
+
+#: candidate fields DERIVED from the depth (documentation riders in the
+#: persisted config, not independent axes) — excluded when comparing
+#: candidates for deeper-neighbor pruning, or the mirrored value would make
+#: every deeper candidate look like a different config family
+_DERIVED_FIELDS = ("halo_multiplier",)
+
+
+def deeper_neighbors(cand: dict, candidates: List[dict], depth_key: Optional[str]) -> List[dict]:
+    """Candidates identical to ``cand`` except for a LARGER ``depth_key``
+    value — the ones a VMEM_OOM at ``cand`` proves can't compile either.
+    Depth-derived riders (``halo_multiplier == m``) are ignored in the
+    comparison."""
+    if not depth_key or depth_key not in cand:
+        return []
+
+    def base_of(c):
+        return {
+            k: v
+            for k, v in c.items()
+            if k != depth_key and k not in _DERIVED_FIELDS
+        }
+
+    base = base_of(cand)
+    return [
+        c
+        for c in candidates
+        if c is not cand
+        and c.get(depth_key) is not None
+        and base_of(c) == base
+        and c[depth_key] > cand[depth_key]
+    ]
+
+
+#: depth grid spanning the measured plateau and its edges (probe20b/c/d:
+#: k=8 128-132, k=12 190, k=16 142-202, k=20-24 ~190, k=32 152 Gcells/s)
+_DEPTH_GRID = (4, 8, 12, 16, 20, 24)
+
+
+def jacobi_wrap_space(
+    shape: Tuple[int, int, int],
+    itemsize: int,
+    static_k: int,
+    ks=None,
+) -> Tuple[List[dict], int]:
+    """(candidates, prefiltered_count) over the wrap kernel's temporal depth
+    ``k``.  ``ks`` overrides the grid (tests / narrow re-qualification)."""
+    from stencil_tpu.ops.jacobi_pallas import wavefront_vmem_fits
+
+    X, Y, Z = shape
+    grid = sorted({static_k, *(ks if ks is not None else _DEPTH_GRID)})
+    grid = [k for k in grid if 1 <= k <= max(1, X // 2)]
+    kept, prefiltered = [], 0
+    for k in grid:
+        # the static pick always runs (it IS the fallback being defended);
+        # other depths must pass the VMEM model to be worth a compile
+        if k == static_k or wavefront_vmem_fits(k, Y, Z, itemsize):
+            kept.append({"k": k})
+        else:
+            prefiltered += 1
+    return kept, prefiltered
+
+
+def jacobi_wavefront_space(
+    static_m: int,
+    depth_cap: int,
+    z_ring_eligible: bool,
+    static_z_ring: bool,
+    ms=None,
+) -> Tuple[List[dict], int]:
+    """(candidates, prefiltered) over the multi-device wavefront: depth ``m``
+    (== the halo multiplier: the m-wide shell is exchanged every m steps),
+    alias on/off, and — at the static depth — z-ring vs padded layout.
+    ``depth_cap`` is the structural bound (shard/valid extents)."""
+    grid = sorted({static_m, *(ms if ms is not None else _DEPTH_GRID)})
+    grid = [m for m in grid if 1 <= m <= depth_cap]
+    cands: List[dict] = []
+    for m in grid:
+        for alias in (False, True):
+            cands.append(
+                {
+                    "m": m,
+                    "halo_multiplier": m,
+                    "alias": alias,
+                    "z_ring": static_z_ring and z_ring_eligible,
+                }
+            )
+    if z_ring_eligible:
+        # the layout A/B at the static depth only: probe25d measured it
+        # NEUTRAL on v5e, so one pair per search re-qualifies it cheaply
+        cands.append(
+            {
+                "m": static_m,
+                "halo_multiplier": static_m,
+                "alias": False,
+                "z_ring": not static_z_ring,
+            }
+        )
+    return cands, 0
+
+
+def stream_space(dd, x_radius: int, separable: bool, static_plan: dict) -> Tuple[List[dict], int]:
+    """(candidates, prefiltered) of full stream-engine plans around the
+    static pick: the static plan, its shallower depths, the alias flip, and
+    the plane route as the m=1 structural baseline.  Every candidate is a
+    plan dict ``_build_stream_step`` accepts verbatim (+ ``alias``)."""
+    from stencil_tpu.ops.stream import plan_stream
+
+    cands: List[dict] = []
+
+    def add(plan: dict, alias: Optional[bool]) -> None:
+        c = dict(plan)
+        if alias is not None:
+            c["alias"] = alias
+        c.setdefault("halo_multiplier", c.get("m", 1))
+        if c not in cands:
+            cands.append(c)
+
+    nq = len(dd._handles)
+    static_alias = nq >= 4  # the _build_stream_step auto rule
+    add(static_plan, static_alias if static_plan["route"] != "wrap" else None)
+    if static_plan["route"] in ("wavefront", "wrap"):
+        m = static_plan["m"]
+        depths = sorted({d for d in (*_DEPTH_GRID, m // 2) if 2 <= d < m})[-2:]
+        for d in depths:
+            shallower = plan_stream(
+                dd, x_radius, static_plan["route"], separable, max_m=d
+            )
+            add(shallower, static_alias if shallower["route"] != "wrap" else None)
+        if static_plan["route"] == "wavefront":
+            add(static_plan, not static_alias)  # the alias A/B (probe21b)
+    if static_plan["route"] != "plane":
+        try:
+            add(plan_stream(dd, x_radius, "plane", separable), None)
+        except ValueError:
+            pass
+    return cands, 0

@@ -1,0 +1,265 @@
+"""Burst-aware trial protocol + candidate search.
+
+This is the measurement discipline PERF_NOTES.md mandates, implemented:
+
+* **Alternate candidates within one process.**  The first timed run after an
+  idle/compile gap is up to ~35% faster than steady state (probe25d rep0),
+  so sequential best-of-N per candidate spuriously favors whichever side ran
+  first.  Here every measurement round visits every surviving candidate
+  before any candidate is visited again.
+* **Discard rep 0.**  Each candidate's first round eats its own post-idle
+  burst; it never enters the statistic.
+* **Steady-state median.**  Contention noise on shared chips is heavy-tailed
+  (the k-plateau measured 142-202 Gcells/s at one config); the median of the
+  remaining rounds is the per-candidate figure of merit.
+
+Dispatch sizing rides ``bin/_common.timed_inner_loop`` (device-side
+iteration, host-round-trip subtraction, auto-scaled inner count) calibrated
+once on the first surviving candidate and reused for all — candidates tune
+the SAME workload, so one calibration keeps the rounds comparable.
+
+Failures route through the resilience taxonomy (``resilience/taxonomy.py``):
+a ``VMEM_OOM`` prunes the candidate AND its deeper neighbors (a deeper
+temporal depth can only need more VMEM), a ``COMPILE_REJECT`` prunes just
+the candidate, ``TRANSIENT_RUNTIME`` retries via the PR-1 retry policy, and
+``DIVERGENCE``/``FATAL`` propagate.  ``STENCIL_FAULT_PLAN`` hooks fire at
+``compile``/``execute`` phases with labels ``tune:<route>:<candidate>`` so
+every pruning path is testable on CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Callable, List, Optional
+
+from stencil_tpu import telemetry
+from stencil_tpu.resilience import inject
+from stencil_tpu.resilience.retry import execute_with_retry
+from stencil_tpu.resilience.taxonomy import FailureClass, classify
+from stencil_tpu.telemetry import names as tm
+from stencil_tpu.tune.key import WorkloadKey
+from stencil_tpu.tune.space import candidate_label, deeper_neighbors
+
+
+@dataclasses.dataclass
+class TrialResult:
+    """One candidate's outcome: a steady-state figure, or why it was pruned."""
+
+    config: dict
+    seconds_per_iter: Optional[float] = None  # steady-state median, per RAW iter
+    samples: List[float] = dataclasses.field(default_factory=list)
+    pruned: bool = False
+    failure_class: Optional[str] = None
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TuneReport:
+    """What a ``tune.ensure``/search run decided and how it got there."""
+
+    key: WorkloadKey
+    source: str  # "cache" | "search" | "static"
+    config: Optional[dict]
+    trials: int = 0  # candidates actually measured this run (0 on cache hit)
+    pruned: int = 0
+    results: List[TrialResult] = dataclasses.field(default_factory=list)
+    cache_path: Optional[str] = None
+    #: the no-tune fallback the search had to defend (bench embeds its
+    #: steady-state number next to the winner's)
+    static_config: Optional[dict] = None
+
+    @property
+    def cache_hit(self) -> bool:
+        return self.source == "cache"
+
+    def result_for(self, config: dict) -> Optional[TrialResult]:
+        for r in self.results:
+            if r.config == config:
+                return r
+        return None
+
+    def to_json(self) -> dict:
+        """JSON-safe summary for BENCH artifacts / --metrics-out files."""
+        return {
+            "source": self.source,
+            "config": self.config,
+            "trials": self.trials,
+            "pruned": self.pruned,
+            "results": [
+                {
+                    "config": r.config,
+                    "seconds_per_iter": r.seconds_per_iter,
+                    "pruned": r.pruned,
+                    "failure_class": r.failure_class,
+                }
+                for r in self.results
+            ],
+        }
+
+
+def _prune(result: TrialResult, cls: FailureClass, exc: BaseException) -> None:
+    result.pruned = True
+    result.failure_class = cls.value
+    result.error = str(exc)[:300]
+
+
+def measure_alternating(
+    runs: List[Callable[[int], None]],
+    inner,
+    rt: float,
+    reps: int,
+    timer: Callable[[], float] = time.perf_counter,
+) -> List[List[float]]:
+    """``reps`` steady-state per-iteration samples for each run in ``runs``,
+    measured under the burst-aware protocol: ``reps + 1`` rounds alternating
+    across all runs within this process, the rep-0 (post-idle-burst) round
+    discarded.  Every run must already be warmed at its inner count
+    (compiles must not land inside the timing).  ``inner`` is one dispatch
+    size for all runs, or a per-run list (``bench.py`` sizes its headline
+    and exchange-path dispatches differently).  Shared by the autotuner and
+    ``bench.py``'s headline-vs-exchange-path comparison."""
+    inners = list(inner) if isinstance(inner, (list, tuple)) else [inner] * len(runs)
+    assert len(inners) == len(runs), (len(inners), len(runs))
+    samples: List[List[float]] = [[] for _ in runs]
+    for rep in range(reps + 1):
+        for i, run in enumerate(runs):
+            t0 = timer()
+            run(inners[i])
+            dt = timer() - t0 - rt
+            if rep > 0:  # rep 0 harvests the post-idle burst — discard
+                samples[i].append(dt / inners[i])
+    return samples
+
+
+def search(
+    key: WorkloadKey,
+    candidates: List[dict],
+    build_run: Callable[[dict], Callable[[int], None]],
+    *,
+    depth_key: Optional[str] = None,
+    reps: int = 3,
+    inner: int = 4,
+    rt: Optional[float] = None,
+    prefiltered: int = 0,
+    timer: Callable[[], float] = time.perf_counter,
+) -> TuneReport:
+    """Measure ``candidates`` under the burst-aware protocol and return a
+    ``TuneReport`` whose config is the steady-state winner (or None when
+    every candidate was pruned).
+
+    ``build_run(candidate)`` returns ``run(n)``: one synchronous dispatch of
+    ``n`` RAW iterations (jit-cached per static ``n``) — build/compile
+    failures there are classified and prune rather than crash.
+    ``depth_key`` names the candidate field whose larger values are "deeper"
+    (``k``/``m``): a VMEM_OOM prunes those neighbors untried.
+    ``prefiltered`` counts candidates the caller's VMEM model already
+    excluded — they join the pruned telemetry so the counter reflects the
+    whole space."""
+    from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+
+    if reps < 1:
+        raise ValueError(f"tune trials need reps >= 1, got {reps}")
+    results = [TrialResult(config=dict(c)) for c in candidates]
+    by_id = {id(c): r for c, r in zip(candidates, results)}
+    label_of = {id(c): candidate_label(c) for c in candidates}
+
+    if prefiltered:
+        telemetry.inc(tm.TUNE_PRUNED, prefiltered)
+
+    def prune_with_neighbors(cand, cls, exc):
+        r = by_id[id(cand)]
+        _prune(r, cls, exc)
+        victims = 1
+        if cls is FailureClass.VMEM_OOM:
+            for nb in deeper_neighbors(cand, candidates, depth_key):
+                nr = by_id[id(nb)]
+                if not nr.pruned:
+                    _prune(nr, cls, exc)
+                    victims += 1
+        telemetry.inc(tm.TUNE_PRUNED, victims)
+
+    # --- build + warm (compiles happen here, classified and prunable) -------
+    runs = {}
+    for cand in candidates:
+        r = by_id[id(cand)]
+        if r.pruned:  # a shallower sibling's VMEM_OOM already took it out
+            continue
+        lbl = f"tune:{key.route}:{label_of[id(cand)]}"
+
+        def wrap(run, _lbl):
+            # every invocation (warm, calibration, re-warm, timed rounds)
+            # rides the transient-retry policy with the execute-phase fault
+            # hook INSIDE the retried unit (the run_step dispatch() pattern):
+            # an injected/real transient is consumed by retries, never
+            # crashes the search.  A retried round's sample is inflated by
+            # the backoff, which the steady-state MEDIAN absorbs.
+            def attempt(n):
+                inject.maybe_fail("execute", _lbl)
+                return run(n)
+
+            return lambda n: execute_with_retry(attempt, n, label=_lbl)
+
+        try:
+            inject.maybe_fail("compile", lbl)
+            run = execute_with_retry(build_run, cand, label=lbl)
+            wrapped = wrap(run, lbl)
+            wrapped(inner)  # warm/compile at inner
+        except Exception as e:  # noqa: BLE001 — classified below
+            cls = classify(e)
+            if cls in (FailureClass.VMEM_OOM, FailureClass.COMPILE_REJECT):
+                prune_with_neighbors(cand, cls, e)
+                continue
+            raise
+        runs[id(cand)] = wrapped
+
+    alive = [c for c in candidates if not by_id[id(c)].pruned]
+    if alive:
+        if rt is None:
+            rt = host_round_trip_s()
+        # calibrate the dispatch size once, on the first survivor (the
+        # candidates share one workload, so one inner count keeps rounds
+        # comparable); its samples are discarded — the alternating rounds
+        # below are the only ones that count
+        _, inner = timed_inner_loop(runs[id(alive[0])], inner, rt, 1)
+        for c in alive[1:]:
+            runs[id(c)](inner)  # re-warm at the calibrated static count
+        rounds = measure_alternating(
+            [runs[id(c)] for c in alive], inner, rt, reps, timer=timer
+        )
+        for c, samples in zip(alive, rounds):
+            r = by_id[id(c)]
+            r.samples = samples
+            r.seconds_per_iter = statistics.median(samples)
+            telemetry.inc(tm.TUNE_TRIALS)
+            telemetry.emit_event(
+                tm.EVENT_TUNE_TRIAL,
+                key=key.label(),
+                candidate=label_of[id(c)],
+                seconds_per_iter=r.seconds_per_iter,
+            )
+    for r in results:
+        if r.pruned:
+            telemetry.emit_event(
+                tm.EVENT_TUNE_TRIAL,
+                key=key.label(),
+                candidate=candidate_label(r.config),
+                failure_class=r.failure_class,
+                error=r.error,
+            )
+
+    winner: Optional[TrialResult] = None
+    for r in results:
+        if r.seconds_per_iter is None:
+            continue
+        if winner is None or r.seconds_per_iter < winner.seconds_per_iter:
+            winner = r
+    return TuneReport(
+        key=key,
+        source="search",
+        config=dict(winner.config) if winner else None,
+        trials=sum(1 for r in results if r.seconds_per_iter is not None),
+        pruned=prefiltered + sum(1 for r in results if r.pruned),
+        results=results,
+    )

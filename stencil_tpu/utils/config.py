@@ -76,6 +76,85 @@ def env_bool(name: str, default: bool) -> bool:
     return val
 
 
+def env_str(name: str, default=None):
+    """Validated-read-site string env read: empty and unset both mean
+    "use the default", so a knob cleared with ``NAME=`` behaves like an
+    unset one instead of smuggling an empty path/choice downstream."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw
+
+
+def env_choice(name: str, default: str, choices) -> str:
+    """``env_bool`` for small closed vocabularies (e.g. auto/0/1): anything
+    outside ``choices`` raises naming the variable at the read site instead
+    of silently falling through a string-compare chain."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    val = raw.strip()
+    if val not in choices:
+        raise ValueError(
+            f"{name}={raw!r} is not one of {'/'.join(sorted(choices))} "
+            f"(or unset {name})"
+        )
+    return val
+
+
+def apply_compile_cache() -> str:
+    """Point XLA's persistent compilation cache at
+    ``STENCIL_COMPILE_CACHE_DIR`` (validated read) so repeat runs stop
+    re-paying trace+compile — on tunneled backends that includes the flaky
+    remote-compile round trips that killed BENCH_r05.json.
+
+    Called at ``stencil_tpu`` package import, i.e. before any of this
+    framework's code can trigger a backend compile: the directory is
+    created, exported as ``JAX_COMPILATION_CACHE_DIR`` (which jax reads at
+    its own import), and — when jax is already imported — also applied to
+    the live config (the cache itself initializes lazily at first compile,
+    so post-import application is still "before first backend use").
+    Returns the resolved path, or None when the knob is unset OR unusable —
+    an import-time read must never crash the process (the
+    STENCIL_OUTPUT_LEVEL / STENCIL_LOG_TIMESTAMPS convention), so an
+    uncreatable directory warns naming the variable and runs uncached."""
+    path = env_str("STENCIL_COMPILE_CACHE_DIR", None)
+    if path is None:
+        return None
+    path = os.path.abspath(os.path.expanduser(path))
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        from stencil_tpu.utils.logging import log_warn
+
+        log_warn(
+            f"STENCIL_COMPILE_CACHE_DIR={path!r} is not a usable directory "
+            f"({e}); running WITHOUT a persistent compile cache — point it "
+            "at a writable path or unset it"
+        )
+        return None
+    # precedence must not depend on import order: when jax's NATIVE knob is
+    # already exported to a different path, it wins everywhere (we neither
+    # overwrite the env nor touch the live config) and we say so once
+    existing = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if existing and existing != path:
+        from stencil_tpu.utils.logging import log_warn
+
+        log_warn(
+            f"JAX_COMPILATION_CACHE_DIR={existing!r} is already set; it "
+            f"takes precedence over STENCIL_COMPILE_CACHE_DIR={path!r}"
+        )
+        return existing
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+    import sys
+
+    if "jax" in sys.modules:  # jax read the env at its own import — re-apply
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+    return path
+
+
 class MethodFlags(enum.Flag):
     Non = 0
     # TPU-native methods

@@ -18,6 +18,15 @@ _platform = os.environ.get("STENCIL_TEST_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
+# Hermetic autotuner: the fast-path planners consult the persistent tuned-
+# config cache (stencil_tpu/tune/), and a developer's real cache entries
+# must not leak into route/depth assertions (nor test runs pollute theirs) —
+# so FORCE a fresh directory, overriding any exported STENCIL_TUNE_CACHE.
+# Tests that exercise the cache point it at their own tmp_path.
+import tempfile  # noqa: E402
+
+os.environ["STENCIL_TUNE_CACHE"] = tempfile.mkdtemp(prefix="stencil_tune_test_")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)

@@ -63,6 +63,9 @@ def _computation_block(lines, idx):
     return start, end
 
 
+@pytest.mark.slow  # tier-2 (the module docstring's intent): one AOT compile
+# of the overlapped step against the real TPU compiler costs ~8 MINUTES of
+# wall clock — over half the tier-1 870s budget (measured 481s, 2026-08-03)
 def test_overlapped_step_schedule_straddles_interior():
     devices = _topology_devices()
     dd = DistributedDomain(256, 256, 128)
@@ -103,6 +106,9 @@ def test_overlapped_step_schedule_straddles_interior():
     assert max(dones) > i0, (max(dones), i0)
 
 
+@pytest.mark.slow  # tier-2 with its sibling above: same real-TPU-compiler
+# AOT compile; standalone (without the first test having warmed the
+# compiler) it costs minutes of tier-1 wall clock
 def test_no_overlap_step_schedule_serializes():
     """Sanity inverse: without the interior/exterior split the whole-region
     compute depends on every halo, so no permute can remain in flight across

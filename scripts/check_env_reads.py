@@ -1,136 +1,20 @@
 #!/usr/bin/env python
-"""Lint: every ``STENCIL_*`` environment variable is read through
-``utils/config.py``'s validated helpers (``env_int`` / ``env_float`` /
-``env_bool`` / ``env_str`` / ``env_choice``), never via a raw
-``os.environ`` / ``os.getenv`` at a call site.
+"""Thin shim: the env-read lint now lives in the stencil-lint framework.
 
-Why: a raw read silently accepts malformed values (``"0 "`` vs ``"0"``,
-``"16MB"`` vs bytes) and each site invents its own truthiness convention;
-the validated helpers raise a message NAMING the variable at the read site
-and keep one boolean vocabulary.  PR-1/PR-2 converted the tree; the tuner
-added two more knobs (``STENCIL_TUNE``, ``STENCIL_TUNE_CACHE``) — this lint
-keeps the invariant checkable so the NEXT knob cannot regress it.
+Historical entry point kept so existing invocations (CI snippets, muscle
+memory) keep working; the rule logic is ``stencil_tpu/lint/rules/
+env_reads.py`` and the grandfathered sites are inline
+``# stencil-lint: disable=env-read`` suppressions at the reads themselves.
 
-Scope: ``stencil_tpu/`` and ``bench.py``.  ``utils/config.py`` itself is
-the one place raw reads are allowed.  Two sites are grandfathered with
-documented reasons (see ``ALLOWED``); anything new fails.
-
-Run directly (``python scripts/check_env_reads.py``) or through the tier-1
-test ``tests/test_tune.py::test_env_read_lint``.  Exit 0 = clean.
+Equivalent: ``python -m stencil_tpu.lint --select env-read``.
 """
 
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-#: the ONE module allowed to touch os.environ for STENCIL_* names
-CONFIG_MODULE = os.path.join("stencil_tpu", "utils", "config.py")
-
-#: grandfathered raw reads, each with a reason the helper cannot serve
-ALLOWED = {
-    # import-time level parse: a logging import must never crash the
-    # process, so malformed values warn-and-default instead of raising
-    (os.path.join("stencil_tpu", "utils", "logging.py"), "STENCIL_OUTPUT_LEVEL"),
-    # the fault plan re-parses whenever the env VALUE changes (tests
-    # monkeypatch it mid-process); the helpers have no change-detection
-    (os.path.join("stencil_tpu", "resilience", "inject.py"), "STENCIL_FAULT_PLAN"),
-}
-
-_ENV_FUNCS = {"getenv"}  # os.getenv(...)
-_OS_NAMES = {"os", "_os"}
-
-
-def _env_read_var(node: ast.expr):
-    """The STENCIL_* literal read by this expression, or None.
-
-    Matches ``os.environ.get(LIT, ...)``, ``os.environ[LIT]``,
-    ``os.getenv(LIT, ...)``, and the bare-``environ`` forms from
-    ``from os import environ``."""
-
-    def _is_environ(expr: ast.expr) -> bool:
-        if isinstance(expr, ast.Attribute) and expr.attr == "environ":
-            return isinstance(expr.value, ast.Name) and expr.value.id in _OS_NAMES
-        return isinstance(expr, ast.Name) and expr.id == "environ"
-
-    def _lit(args):
-        if args and isinstance(args[0], ast.Constant) and isinstance(args[0].value, str):
-            return args[0].value
-        return None
-
-    if isinstance(node, ast.Call):
-        f = node.func
-        if isinstance(f, ast.Attribute) and f.attr == "get" and _is_environ(f.value):
-            return _lit(node.args)
-        if (
-            isinstance(f, ast.Attribute)
-            and f.attr in _ENV_FUNCS
-            and isinstance(f.value, ast.Name)
-            and f.value.id in _OS_NAMES
-        ):
-            return _lit(node.args)
-    if isinstance(node, ast.Subscript) and _is_environ(node.value):
-        sl = node.slice
-        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
-            return sl.value
-    return None
-
-
-def check_file(path: str) -> list:
-    with open(path) as fh:
-        try:
-            tree = ast.parse(fh.read(), filename=path)
-        except SyntaxError as e:  # a broken file is someone else's failure
-            return [f"{path}: syntax error during lint: {e}"]
-    rel = os.path.relpath(path, REPO)
-    if rel == CONFIG_MODULE:
-        return []
-    problems = []
-    for node in ast.walk(tree):
-        var = _env_read_var(node)
-        if var is None or not var.startswith("STENCIL_"):
-            continue
-        if (rel, var) in ALLOWED:
-            continue
-        problems.append(
-            f"{rel}:{node.lineno}: raw environment read of {var!r} — use a "
-            "validated helper from stencil_tpu/utils/config.py (env_int/"
-            "env_float/env_bool/env_str/env_choice) so malformed values "
-            "fail naming the variable"
-        )
-    return problems
-
-
-def iter_files():
-    for dirpath, _, files in os.walk(os.path.join(REPO, "stencil_tpu")):
-        for f in sorted(files):
-            if f.endswith(".py"):
-                yield os.path.join(dirpath, f)
-    yield os.path.join(REPO, "bench.py")
-
-
-def main(argv=None) -> int:
-    problems = []
-    for path in iter_files():
-        problems.extend(check_file(path))
-    # the allowlist must not rot: every entry must still exist
-    for rel, var in sorted(ALLOWED):
-        full = os.path.join(REPO, rel)
-        if not os.path.exists(full) or var not in open(full).read():
-            problems.append(
-                f"ALLOWED entry ({rel}, {var}) no longer matches a read — "
-                "remove it from scripts/check_env_reads.py"
-            )
-    for p in problems:
-        print(p, file=sys.stderr)
-    if problems:
-        print(f"{len(problems)} raw-env-read problem(s)", file=sys.stderr)
-        return 1
-    return 0
-
+from stencil_tpu.lint import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--select", "env-read"]))

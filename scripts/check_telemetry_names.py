@@ -1,163 +1,16 @@
 #!/usr/bin/env python
-"""Lint: every telemetry metric/event name used in the tree is registered in
-the canonical names module (``stencil_tpu/telemetry/names.py``).
+"""Thin shim: the telemetry-names lint now lives in the stencil-lint
+framework (``stencil_tpu/lint/rules/telemetry_names.py``).
 
-Two rules, enforced over ``stencil_tpu/``, ``bench.py``, and ``tests/``
-(the telemetry package internals are exempt — they pass names through as
-parameters):
-
-1. A telemetry API call (``telemetry.inc`` / ``observe`` / ``set_gauge`` /
-   ``emit_event`` / ``span`` / ``record_span`` / ``counter`` / ``gauge`` /
-   ``histogram``) whose first argument is a STRING LITERAL must use a
-   literal that is registered in ``names.ALL_NAMES`` — a free string that
-   is not registered silently forks the time series across rounds.
-2. An attribute reference ``names.X`` / ``tm.X`` (the aliases this tree
-   imports the module under) must name an existing constant — a typo'd
-   constant would otherwise surface only at runtime on the telemetry path.
-
-Run directly (``python scripts/check_telemetry_names.py``) or through the
-tier-1 test ``tests/test_telemetry.py::test_names_lint``.  Exit 0 = clean.
+Equivalent: ``python -m stencil_tpu.lint --select telemetry-name``.
 """
 
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-#: telemetry facade entry points whose first positional arg is a series name
-NAME_TAKING_CALLS = {
-    "inc",
-    "observe",
-    "set_gauge",
-    "emit_event",
-    "span",
-    "record_span",
-    "counter",
-    "gauge",
-    "histogram",
-}
-
-#: module aliases the tree uses for the telemetry facade and the names module
-FACADE_ALIASES = {"telemetry"}
-NAMES_ALIASES = {"names", "tm"}
-
-EXEMPT_PREFIXES = (
-    os.path.join("stencil_tpu", "telemetry") + os.sep,  # pass names through
-    "scripts" + os.sep,
-)
-
-
-def _registered_names():
-    sys.path.insert(0, REPO)
-    try:
-        from stencil_tpu.telemetry import names
-    finally:
-        sys.path.pop(0)
-    constants = {
-        k: v
-        for k, v in vars(names).items()
-        if k.isupper() and isinstance(v, str)
-    }
-    return names.ALL_NAMES, constants
-
-
-def _is_telemetry_call(node: ast.Call) -> bool:
-    """``telemetry.<api>(...)`` or a bare ``<api>(...)`` name imported from
-    the facade — bare names are matched by name alone, which is safe because
-    the API verbs are distinctive (``emit_event``, ``record_span``, ...) and
-    a false positive only ever asks the author to register a name."""
-    f = node.func
-    if isinstance(f, ast.Attribute):
-        return (
-            isinstance(f.value, ast.Name)
-            and f.value.id in FACADE_ALIASES
-            and f.attr in NAME_TAKING_CALLS
-        )
-    if isinstance(f, ast.Name):
-        # bare imports: only the unambiguous verbs (plain `span`/`counter`
-        # etc. collide with too many local names to match blindly)
-        return f.id in {"emit_event", "record_span", "set_gauge"}
-    return False
-
-
-def check_file(path: str, all_names, constants) -> list:
-    with open(path) as fh:
-        try:
-            tree = ast.parse(fh.read(), filename=path)
-        except SyntaxError as e:  # a broken file is someone else's failure
-            return [f"{path}: syntax error during lint: {e}"]
-    rel = os.path.relpath(path, REPO)
-    problems = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and _is_telemetry_call(node):
-            if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
-                node.args[0].value, str
-            ):
-                lit = node.args[0].value
-                if lit not in all_names:
-                    problems.append(
-                        f"{rel}:{node.lineno}: free-string telemetry name "
-                        f"{lit!r} — register it in "
-                        "stencil_tpu/telemetry/names.py and reference the "
-                        "constant"
-                    )
-        if (
-            isinstance(node, ast.Attribute)
-            and isinstance(node.value, ast.Name)
-            and node.value.id in NAMES_ALIASES
-            and node.attr.isupper()
-            and node.attr not in constants
-            and not node.attr.startswith("ALL_")
-        ):
-            problems.append(
-                f"{rel}:{node.lineno}: names.{node.attr} is not defined in "
-                "stencil_tpu/telemetry/names.py"
-            )
-    return problems
-
-
-def iter_files():
-    for root in ("stencil_tpu", "tests"):
-        for dirpath, _, files in os.walk(os.path.join(REPO, root)):
-            for f in sorted(files):
-                if not f.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, f)
-                rel = os.path.relpath(path, REPO)
-                if rel.startswith(EXEMPT_PREFIXES):
-                    continue
-                yield path
-    yield os.path.join(REPO, "bench.py")
-
-
-def main(argv=None) -> int:
-    all_names, constants = _registered_names()
-    problems = []
-    for path in iter_files():
-        problems.extend(check_file(path, all_names, constants))
-    # the registry itself must be internally consistent: constants unique
-    # and well-formed
-    seen = {}
-    for const, value in sorted(constants.items()):
-        if not all(part for part in value.split(".")) or value != value.lower():
-            problems.append(
-                f"names.{const} = {value!r}: names are lowercase dotted paths"
-            )
-        if value in seen:
-            problems.append(
-                f"names.{const} duplicates names.{seen[value]} ({value!r})"
-            )
-        seen[value] = const
-    for p in problems:
-        print(p, file=sys.stderr)
-    if problems:
-        print(f"{len(problems)} telemetry-name problem(s)", file=sys.stderr)
-        return 1
-    return 0
-
+from stencil_tpu.lint import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--select", "telemetry-name"]))

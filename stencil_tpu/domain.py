@@ -897,6 +897,7 @@ class DistributedDomain:
                 slice(lo[ax] + region[ax].start, lo[ax] + region[ax].stop) for ax in range(3)
             )
             # leading component dims (N-D data) ride unsliced
+            # stencil-lint: disable=halo-set-in-loop interior compute-region write on the generic correctness-first path, not a halo sliver; the measured fast paths go through ops/stream.py's aliased kernels
             return new_block.at[(Ellipsis,) + idx].set(vals)
 
         def one_step(blocks):

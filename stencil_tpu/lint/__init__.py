@@ -1,0 +1,27 @@
+"""stencil-lint — AST-based static checks for this tree's TPU invariants.
+
+Entry points:
+
+* ``python -m stencil_tpu.lint`` — lint the default surface, human output.
+* ``python -m stencil_tpu.lint --json`` — machine output (CI artifacts).
+* ``python -m stencil_tpu.lint --changed-only`` — pre-commit fast path.
+* ``from stencil_tpu.lint import run_lint`` — the in-process tier-1 test.
+
+Rule catalog, suppression syntax, and how to add a rule:
+``docs/static-analysis.md``.
+"""
+
+from stencil_tpu.lint.framework import (  # noqa: F401
+    REPO,
+    FileContext,
+    Rule,
+    Suppression,
+    Violation,
+    all_rules,
+    default_files,
+    lint_paths,
+    lint_source,
+    register,
+    run_lint,
+)
+from stencil_tpu.lint.cli import main  # noqa: F401

@@ -1,0 +1,110 @@
+"""Small AST helpers shared by the rule modules (stdlib ``ast`` only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def dotted(node: ast.expr) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Trailing name of the called expression: ``lax.fori_loop(...)`` ->
+    ``fori_loop``; ``foo(...)`` -> ``foo``."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def keyword(node: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def const_int_set(node: ast.expr) -> Optional[Set[int]]:
+    """``0`` -> {0}; ``(0, 2)`` / ``[0, 2]`` -> {0, 2}; else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant) and isinstance(el.value, int)):
+                return None
+            out.add(el.value)
+        return out
+    return None
+
+
+def module_defs(tree: ast.Module) -> Dict[str, List[ast.AST]]:
+    """Every FunctionDef/AsyncFunctionDef in the module keyed by bare name
+    (nested defs included — lint resolution is by-name, best effort)."""
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def called_names(node: ast.AST) -> Set[str]:
+    """Bare trailing names of every call in the subtree (``f()``, ``o.f()``
+    both yield ``f``) plus bare-Name arguments passed to calls (functions
+    handed onward as values, e.g. loop bodies and extender callbacks)."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            cn = call_name(n)
+            if cn:
+                out.add(cn)
+            for a in n.args:
+                if isinstance(a, ast.Name):
+                    out.add(a.id)
+    return out
+
+
+def is_at_set_call(node: ast.AST) -> bool:
+    """``x.at[...].set(...)`` (the jnp indexed-update form)."""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "set"
+        and isinstance(node.func.value, ast.Subscript)
+        and isinstance(node.func.value.value, ast.Attribute)
+        and node.func.value.value.attr == "at"
+    )
+
+
+def function_scopes(tree: ast.Module) -> Iterator[ast.AST]:
+    """The module plus every function node — the scopes rules iterate."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, FUNC_NODES):
+            yield node
+
+
+def decorator_names(node) -> List[str]:
+    """Dotted names of decorators; for decorator CALLS, the dotted name of
+    the called expression (``@partial(jax.jit, ...)`` -> ``partial``)."""
+    out = []
+    for d in getattr(node, "decorator_list", []):
+        target = d.func if isinstance(d, ast.Call) else d
+        name = dotted(target)
+        if name:
+            out.append(name)
+    return out

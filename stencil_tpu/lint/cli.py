@@ -1,0 +1,86 @@
+"""Command-line front end: ``python -m stencil_tpu.lint`` / ``stencil-lint``.
+
+Exit codes: 0 clean, 1 violations found, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from stencil_tpu.lint import framework
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="stencil-lint",
+        description=(
+            "Machine-check this tree's TPU invariants (validated env reads, "
+            "jax-free telemetry, donated-buffer safety, PERF_NOTES layout "
+            "traps, tier-1 budget discipline).  See docs/static-analysis.md."
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files to lint (default: the whole checked surface — "
+        "stencil_tpu/, tests/, bench.py, scripts/*.py)",
+    )
+    p.add_argument(
+        "--select",
+        metavar="RULE[,RULE...]",
+        help="run only these rules (comma-separated ids)",
+    )
+    p.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="lint only files changed vs git HEAD (plus untracked) — the "
+        "fast pre-commit mode",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output on stdout"
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog (id + rationale) and exit",
+    )
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for cls in sorted(framework.all_rules(), key=lambda c: c.name):
+            print(f"{cls.name}: {cls.why}")
+        return 0
+    select = args.select.split(",") if args.select else None
+    if args.paths and args.changed_only:
+        print("--changed-only and explicit paths are exclusive", file=sys.stderr)
+        return 2
+    try:
+        if args.changed_only:
+            files = framework.changed_files()
+        elif args.paths:
+            files = args.paths
+        else:
+            files = framework.default_files()
+        violations = framework.lint_paths(files, select=select)
+    except ValueError as e:  # unknown --select rule
+        print(str(e), file=sys.stderr)
+        return 2
+    except OSError as e:  # unreadable/nonexistent path: usage, not lint, error
+        print(f"cannot read {e.filename or ''}: {e.strerror}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(framework.render_json(violations, files_checked=len(files)))
+    else:
+        framework.render_human(violations)
+        if not violations:
+            print(f"stencil-lint: {len(files)} file(s) clean", file=sys.stderr)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

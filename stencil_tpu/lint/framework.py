@@ -1,0 +1,441 @@
+"""stencil-lint core: rule registry, suppression grammar, file engine.
+
+The reference C++ library machine-checked its invariants with compile-time
+types and mandatory error macros (``CUDA_RUNTIME`` / ``NVML``,
+cuda_runtime.hpp:15); a Python port has neither, so the invariants PRs 1-3
+established — validated env reads, jax-free telemetry, donated-buffer
+safety, the PERF_NOTES layout traps, the tier-1 time budget — lived in
+reviewer memory plus two one-off scripts.  This package turns each of them
+into a registered :class:`Rule` over the stdlib ``ast``, with one entry
+point (``python -m stencil_tpu.lint``) and one in-process tier-1 test.
+
+Design constraints:
+
+* **No jax, no third-party imports** — the linter must run in milliseconds
+  in any interpreter (pre-commit, CI collection, the tier-1 gate).
+* **Suppressions require a reason.**  A ``stencil-lint`` comment of the
+  form ``disable=<rule> <why>`` on the flagged line (or the line directly
+  above) silences that rule there;
+  a bare ``disable=`` with no reason is itself a violation, and so is a
+  suppression that no longer matches anything (allowlists must not rot —
+  the same policy the old ``check_env_reads.ALLOWED`` set enforced).
+* **Rules are data**: id, rationale, scope predicate, per-file ``check``,
+  optional whole-run ``finalize`` for cross-file consistency checks.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import subprocess
+import sys
+import tokenize
+from typing import Iterable, List, Optional, Sequence
+
+#: repo root = the directory holding the ``stencil_tpu`` package
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: rule id used for problems with the suppression comments themselves
+SUPPRESSION_RULE = "bad-suppression"
+
+#: rule id used for files the engine cannot parse at all
+SYNTAX_RULE = "syntax-error"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*stencil-lint:\s*disable=([A-Za-z0-9_,-]+)[ \t]*(.*?)\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding: rule id, repo-relative path, 1-based line, message."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    line: int  # line the comment sits on
+    rules: tuple  # rule ids named in disable=
+    reason: str
+    end: int = 0  # last covered line (>= line + 1 once resolved)
+
+    def covers(self, line: int) -> bool:
+        """A suppression covers its own line through ``end``: the line
+        directly below, extended by the engine over the full span of the
+        statement starting there (so a comment above a wrapped call covers
+        every continuation line; compound statements extend only over
+        their header, never the whole body)."""
+        return self.line <= line <= max(self.end, self.line + 1)
+
+
+class FileContext:
+    """Parsed source handed to every rule: path, repo-relative path, text,
+    AST (``None`` when the file does not parse), and raw lines."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(source, filename=path)
+            self.syntax_error: Optional[SyntaxError] = None
+        except SyntaxError as e:  # a broken file is its author's failure
+            self.tree = None
+            self.syntax_error = e
+        self.suppressions: List[Suppression] = _resolve_spans(
+            _parse_suppressions(source), self.tree, self.lines
+        )
+
+    def violation(self, rule: str, node_or_line, message: str) -> Violation:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Violation(rule=rule, path=self.rel, line=line, message=message)
+
+
+class Rule:
+    """Base class: subclass, set ``name``/``why``, implement ``check``.
+
+    ``name`` is the id used in output and in ``disable=`` comments.
+    ``why`` is the one-line rationale (``--list-rules``, docs catalog).
+    ``applies_to(rel)`` scopes the rule to part of the tree; the engine
+    only calls ``check`` on files inside that scope.  ``finalize()`` runs
+    once per lint run for cross-file consistency checks (e.g. the
+    telemetry registry's own well-formedness).
+    """
+
+    name: str = ""
+    why: str = ""
+
+    def applies_to(self, rel: str) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> List[Violation]:
+        raise NotImplementedError
+
+    def finalize(self) -> List[Violation]:
+        return []
+
+
+#: the global registry, populated by the ``@register`` decorator at
+#: ``stencil_tpu.lint.rules`` import time
+_REGISTRY: List[type] = []
+
+
+def register(cls: type) -> type:
+    assert cls.name, f"{cls.__name__} must set a rule name"
+    assert cls.name != SUPPRESSION_RULE, "reserved rule id"
+    assert all(cls.name != c.name for c in _REGISTRY), f"duplicate rule {cls.name}"
+    _REGISTRY.append(cls)
+    return cls
+
+
+def all_rules() -> List[type]:
+    """Registered rule classes (importing the rules package on demand)."""
+    from stencil_tpu.lint import rules as _rules  # noqa: F401  (registers)
+
+    return list(_REGISTRY)
+
+
+def _parse_suppressions(source: str) -> List[Suppression]:
+    """Suppressions from real COMMENT tokens only — a string literal or
+    docstring that merely quotes the syntax is not a suppression."""
+    out = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out  # unparseable files are reported by the engine anyway
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if m:
+            rules = tuple(r for r in m.group(1).split(",") if r)
+            out.append(
+                Suppression(line=tok.start[0], rules=rules, reason=m.group(2))
+            )
+    return out
+
+
+def _resolve_spans(
+    suppressions: List[Suppression], tree, lines: Sequence[str]
+) -> List[Suppression]:
+    """Extend each STANDALONE suppression comment over the statement that
+    starts on the next line: wrapped calls anchor violations on
+    continuation lines, and decorated defs anchor below their decorators.
+    Compound statements (def/if/for/...) extend only over their header —
+    a suppression never silences a whole body."""
+    if tree is None or not suppressions:
+        return suppressions
+    span_by_start = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        start = node.lineno
+        for d in getattr(node, "decorator_list", []):
+            start = min(start, d.lineno)
+        body = getattr(node, "body", None)
+        if isinstance(body, list) and body:
+            end = body[0].lineno - 1  # header only
+        else:
+            end = node.end_lineno or node.lineno
+        span_by_start[start] = max(span_by_start.get(start, start), end)
+    out = []
+    for s in suppressions:
+        standalone = (
+            s.line <= len(lines) and lines[s.line - 1].lstrip().startswith("#")
+        )
+        end = span_by_start.get(s.line + 1, s.line + 1) if standalone else 0
+        out.append(dataclasses.replace(s, end=end))
+    return out
+
+
+# --- engine -----------------------------------------------------------------
+
+
+def _select_rules(select: Optional[Iterable[str]]) -> List[Rule]:
+    classes = all_rules()
+    if select is not None:
+        wanted = set(select)
+        known = {c.name for c in classes}
+        unknown = wanted - known
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {sorted(unknown)}; known: {sorted(known)}"
+            )
+        classes = [c for c in classes if c.name in wanted]
+    return [c() for c in classes]
+
+
+def _apply_suppressions(
+    ctx: FileContext, raw: List[Violation], active_rules: Iterable[str]
+) -> List[Violation]:
+    """Drop suppressed violations; emit bad-suppression findings for bare,
+    unknown-rule, and unused suppression comments."""
+    active = set(active_rules)
+    out = []
+    used = set()  # Suppression objects that silenced something
+    for v in raw:
+        silencer = None
+        for s in ctx.suppressions:
+            if v.rule in s.rules and s.covers(v.line) and s.reason:
+                silencer = s
+                break
+        if silencer is not None:
+            used.add(silencer.line)
+        else:
+            out.append(v)
+    known = {c.name for c in all_rules()}
+    for s in ctx.suppressions:
+        if not s.reason:
+            out.append(
+                ctx.violation(
+                    SUPPRESSION_RULE,
+                    s.line,
+                    "suppression without a reason — append why this site "
+                    "is safe after the rule id",
+                )
+            )
+            continue
+        unknown = [r for r in s.rules if r not in known]
+        if unknown:
+            out.append(
+                ctx.violation(
+                    SUPPRESSION_RULE,
+                    s.line,
+                    f"suppression names unknown rule(s) {unknown}; known: "
+                    f"{sorted(known)}",
+                )
+            )
+            continue
+        # rot check: a suppression whose rules all ran yet silenced nothing
+        # no longer matches a violation and must be removed
+        if (
+            s.line not in used
+            and all(r in active for r in s.rules)
+        ):
+            out.append(
+                ctx.violation(
+                    SUPPRESSION_RULE,
+                    s.line,
+                    f"unused suppression for {','.join(s.rules)} — the "
+                    "violation it silenced is gone; remove the comment",
+                )
+            )
+    return out
+
+
+#: directories never linted (measurement probes, fixture corpus, caches)
+EXCLUDED_DIRS = (
+    os.path.join("scripts", "probes"),
+    os.path.join("tests", "lint_fixtures"),
+    "__pycache__",
+)
+
+
+def default_files(repo: str = REPO) -> List[str]:
+    """The checked surface: the product tree plus its tests and the bench
+    driver — ``stencil_tpu/``, ``tests/``, ``bench.py``, and the top-level
+    ``scripts/*.py`` shims.  ``scripts/probes/`` (one-off measurement
+    scripts) and the seeded-violation fixture corpus are out of scope."""
+    out = []
+    for root in ("stencil_tpu", "tests"):
+        for dirpath, dirnames, files in os.walk(os.path.join(repo, root)):
+            dirnames[:] = sorted(
+                d
+                for d in dirnames
+                if not _excluded(os.path.relpath(os.path.join(dirpath, d), repo))
+            )
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+    scripts = os.path.join(repo, "scripts")
+    if os.path.isdir(scripts):
+        for f in sorted(os.listdir(scripts)):
+            if f.endswith(".py"):
+                out.append(os.path.join(scripts, f))
+    bench = os.path.join(repo, "bench.py")
+    if os.path.exists(bench):
+        out.append(bench)
+    return out
+
+
+def _excluded(rel: str) -> bool:
+    parts = rel.split(os.sep)
+    if "__pycache__" in parts:
+        return True
+    for ex in EXCLUDED_DIRS:
+        exp = ex.split(os.sep)
+        if len(exp) > 1 and parts[: len(exp)] == exp:
+            return True
+    return False
+
+
+def changed_files(repo: str = REPO) -> List[str]:
+    """Files changed vs HEAD plus untracked files (for ``--changed-only``
+    pre-commit runs).  Falls back to the full surface when git is absent."""
+    try:
+        diff = subprocess.run(
+            ["git", "-C", repo, "diff", "--name-only", "HEAD", "--"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+            check=True,
+        ).stdout.splitlines()
+        untracked = subprocess.run(
+            ["git", "-C", repo, "ls-files", "--others", "--exclude-standard"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+            check=True,
+        ).stdout.splitlines()
+    except (OSError, subprocess.SubprocessError):
+        return default_files(repo)
+    names = {n.strip() for n in diff + untracked if n.strip().endswith(".py")}
+    return [p for p in default_files(repo) if os.path.relpath(p, repo) in names]
+
+
+def lint_paths(
+    paths: Sequence[str],
+    select: Optional[Iterable[str]] = None,
+    repo: str = REPO,
+) -> List[Violation]:
+    """Lint explicit files.  Returns all violations, sorted by location."""
+    rules = _select_rules(select)
+    active = [r.name for r in rules]
+    out: List[Violation] = []
+    for path in paths:
+        rel = os.path.relpath(os.path.abspath(path), repo)
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        out.extend(_lint_one(FileContext(path, rel, source), rules, active))
+    for r in rules:
+        out.extend(r.finalize())
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def lint_source(
+    source: str,
+    rel: str,
+    select: Optional[Iterable[str]] = None,
+) -> List[Violation]:
+    """Lint an in-memory snippet as if it lived at repo-relative ``rel`` —
+    the fixture-corpus entry point (rules scope themselves by path, so the
+    caller picks which tree location the snippet impersonates)."""
+    rules = _select_rules(select)
+    active = [r.name for r in rules]
+    out = _lint_one(FileContext("<fixture>", rel, source), rules, active)
+    for r in rules:
+        out.extend(r.finalize())
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def _lint_one(
+    ctx: FileContext, rules: List[Rule], active: List[str]
+) -> List[Violation]:
+    raw: List[Violation] = []
+    applicable = [r for r in rules if r.applies_to(ctx.rel)]
+    if ctx.tree is None:
+        if applicable:
+            raw.append(
+                ctx.violation(
+                    SYNTAX_RULE,
+                    ctx.syntax_error.lineno or 1,
+                    f"file does not parse: {ctx.syntax_error.msg}",
+                )
+            )
+        return raw
+    for r in applicable:
+        raw.extend(r.check(ctx))
+    return _apply_suppressions(ctx, raw, [r.name for r in applicable])
+
+
+def run_lint(
+    paths: Optional[Sequence[str]] = None,
+    select: Optional[Iterable[str]] = None,
+    changed_only: bool = False,
+    repo: str = REPO,
+) -> List[Violation]:
+    """Lint the default surface (or explicit ``paths``).  The tier-1 test
+    and the CLI both come through here."""
+    if paths:
+        files = list(paths)
+    elif changed_only:
+        files = changed_files(repo)
+    else:
+        files = default_files(repo)
+    return lint_paths(files, select=select, repo=repo)
+
+
+def render_json(violations: List[Violation], files_checked: int) -> str:
+    return json.dumps(
+        {
+            "violations": [v.as_json() for v in violations],
+            "count": len(violations),
+            "files_checked": files_checked,
+            "rules": sorted(c.name for c in all_rules()),
+        },
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def render_human(violations: List[Violation], stream=None) -> None:
+    stream = stream or sys.stderr
+    for v in violations:
+        print(v.render(), file=stream)
+    if violations:
+        print(f"{len(violations)} stencil-lint problem(s)", file=stream)

@@ -1,0 +1,16 @@
+"""Rule modules — importing this package registers every rule.
+
+Adding a rule: write a module here with a ``@register``-decorated
+:class:`stencil_tpu.lint.Rule` subclass, import it below, document it in
+``docs/static-analysis.md``, and seed a fixture pair in
+``tests/lint_fixtures/`` proving it fires and can be suppressed.
+"""
+
+from stencil_tpu.lint.rules import (  # noqa: F401
+    donation,
+    env_reads,
+    jax_free,
+    layout_traps,
+    telemetry_names,
+    tier1_budget,
+)

@@ -1,0 +1,196 @@
+"""Rule ``donated-reuse``: a binding passed into a donating call
+(``donate_argnums`` / ``input_output_aliases``) must not be read again
+afterwards unless the surrounding code carries a liveness guard.
+
+This is the bug class PR 1's runtime guard exists to catch: every fast-path
+step is jitted with ``donate_argnums=0``, so after ``step(x)`` the buffer
+behind ``x`` may already be freed — re-reading it raises (best case) or
+re-runs on deleted memory on a retry path (worst case; see
+``resilience/retry.py`` ``buffers_live``).  The lint flags the static shape
+of the mistake: a call through a callable *known in this file* to donate
+(its def is decorated ``partial(jax.jit, ..., donate_argnums=...)``, or the
+name was bound to ``jax.jit(f, donate_argnums=...)`` /
+``pallas_call(..., input_output_aliases=...)``), whose donated argument is
+a bare name that is loaded again later in the same scope before any
+rebinding of that name.
+
+Not flagged (the sanctioned patterns):
+
+* rebinding through the result — ``x = step(x)`` — later reads see the
+  fresh buffer, and any rebinding of the name closes the hazard window;
+* scopes that guard with ``is_deleted()`` / ``buffers_live`` or route the
+  re-invocation through ``execute_with_retry`` (the runtime guard);
+* donation through ``**kwargs``, attribute or subscript arguments — those
+  are beyond by-name dataflow and stay the runtime guard's job.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from stencil_tpu.lint import astutil
+from stencil_tpu.lint.framework import FileContext, Rule, register
+
+#: names whose presence in a scope marks the reuse as liveness-guarded
+GUARD_NAMES = {"is_deleted", "buffers_live", "execute_with_retry"}
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    """Donated argument indices declared by this jit/pallas_call invocation,
+    or None when it donates nothing."""
+    kw = astutil.keyword(call, "donate_argnums")
+    if kw is not None:
+        return astutil.const_int_set(kw) or {0}
+    kw = astutil.keyword(call, "input_output_aliases")
+    if kw is not None:
+        if isinstance(kw, ast.Dict):
+            keys = set()
+            for k in kw.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, int):
+                    keys.add(k.value)
+            return keys or {0}
+        return {0}
+    return None
+
+
+def _donating_defs(tree: ast.Module) -> Dict[str, Set[int]]:
+    """name -> donated positions, for every callable this file declares to
+    donate: decorated defs and names assigned from a donating call."""
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    pos = _donated_positions(dec)
+                    if pos:
+                        out[node.name] = pos
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _donated_positions(node.value)
+            if pos:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = pos
+    return out
+
+
+def _own_nodes(scope: ast.AST) -> List[ast.AST]:
+    """Nodes belonging to this scope, excluding nested function/lambda
+    subtrees (each of those is analyzed as its own scope)."""
+    body = scope.body if isinstance(scope.body, list) else [scope.body]
+    own: List[ast.AST] = []
+    stack: List[ast.AST] = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, astutil.FUNC_NODES):
+            continue  # nested scope, analyzed separately
+        own.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return own
+
+
+@register
+class DonatedReuseRule(Rule):
+    name = "donated-reuse"
+    why = (
+        "a buffer passed through donate_argnums/input_output_aliases may "
+        "already be freed; rebind through the result (x = step(x)) or "
+        "guard with is_deleted()/buffers_live before reusing it"
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        return rel.startswith("stencil_tpu/") or rel == "bench.py"
+
+    def check(self, ctx: FileContext) -> List:
+        donating = _donating_defs(ctx.tree)
+        if not donating:
+            return []
+        out = []
+        for scope in astutil.function_scopes(ctx.tree):
+            out.extend(self._check_scope(ctx, scope, donating))
+        return out
+
+    def _check_scope(self, ctx: FileContext, scope, donating) -> List:
+        own = _own_nodes(scope)
+        # a guarded scope (anywhere in its subtree, nested helpers included)
+        # delegates liveness to the runtime check
+        walk_root = scope.body if isinstance(scope.body, list) else [scope.body]
+        for top in walk_root:
+            for n in ast.walk(top):
+                if isinstance(n, (ast.Name, ast.Attribute)):
+                    nm = n.id if isinstance(n, ast.Name) else n.attr
+                    if nm in GUARD_NAMES:
+                        return []
+        assigns = [
+            n
+            for n in own
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+        ]
+        out = []
+        for call in own:
+            if not isinstance(call, ast.Call):
+                continue
+            fname = astutil.call_name(call)
+            if fname not in donating:
+                continue
+            for idx in donating[fname]:
+                if idx >= len(call.args):
+                    continue
+                arg = call.args[idx]
+                if not isinstance(arg, ast.Name):
+                    continue  # attribute/subscript: runtime guard's job
+                if self._rebound_by_own_statement(assigns, call, arg.id):
+                    continue  # x = step(x): reads see the fresh buffer
+                reuse = self._first_event_after(walk_root, call, arg.id)
+                if reuse is not None:
+                    out.append(
+                        ctx.violation(
+                            self.name,
+                            reuse,
+                            f"{arg.id!r} was donated to {fname}() on line "
+                            f"{call.lineno} and may be deleted — rebind "
+                            "through the result or guard with is_deleted()"
+                            "/buffers_live (see resilience/retry.py)",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _rebound_by_own_statement(assigns, call: ast.Call, name: str) -> bool:
+        """True when the statement holding the donating call assigns the
+        donated name — the canonical ``x = step(x)`` swap (incl. tuple
+        targets), after which every read sees the fresh buffer."""
+        for a in assigns:
+            if any(sub is call for sub in ast.walk(a)):
+                targets = a.targets if isinstance(a, ast.Assign) else [a.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id == name:
+                            return True
+        return False
+
+    @staticmethod
+    def _first_event_after(walk_root, call: ast.Call, name) -> Optional[ast.AST]:
+        """The first Load of ``name`` after the donating call, or None when
+        the name is rebound first (a Store closes the hazard window).
+        Nested defs count as loads: a closure capturing the stale binding
+        is just as dead.  Position comparison is (line, col) against the
+        call's END so same-line reuse (``return step(x), x.shape``) is
+        caught while the call's own argument is not."""
+        end = (call.end_lineno or call.lineno, call.end_col_offset or 0)
+        events = []
+        for top in walk_root:
+            for n in ast.walk(top):
+                if (
+                    isinstance(n, ast.Name)
+                    and n.id == name
+                    and (n.lineno, n.col_offset) > end
+                ):
+                    events.append(n)
+        if not events:
+            return None
+        first = min(events, key=lambda n: (n.lineno, n.col_offset))
+        if isinstance(first.ctx, ast.Load):
+            return first
+        return None

@@ -1,0 +1,95 @@
+"""Rule ``env-read``: every ``STENCIL_*`` environment variable is read
+through ``utils/config.py``'s validated helpers (``env_int`` / ``env_float``
+/ ``env_bool`` / ``env_str`` / ``env_choice``), never via a raw
+``os.environ`` / ``os.getenv`` at a call site.
+
+Why: a raw read silently accepts malformed values (``"0 "`` vs ``"0"``,
+``"16MB"`` vs bytes) and each site invents its own truthiness convention;
+the validated helpers raise a message NAMING the variable at the read site
+and keep one boolean vocabulary.  PR-1/PR-2 converted the tree; the old
+``scripts/check_env_reads.py`` grandfather list (logging's import-time
+level parse) is now an inline ``disable=env-read`` suppression at the
+read itself, with the reason alongside the code it excuses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from stencil_tpu.lint.framework import FileContext, Rule, Violation, register
+
+_ENV_FUNCS = {"getenv"}  # os.getenv(...)
+_OS_NAMES = {"os", "_os"}
+
+#: the ONE module allowed to touch os.environ for STENCIL_* names
+CONFIG_MODULE = "stencil_tpu/utils/config.py"
+
+
+def env_read_var(node: ast.expr) -> Optional[str]:
+    """The string literal read by this expression, or None.
+
+    Matches ``os.environ.get(LIT, ...)``, ``os.environ[LIT]``,
+    ``os.getenv(LIT, ...)``, and the bare-``environ`` forms from
+    ``from os import environ``."""
+
+    def _is_environ(expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Attribute) and expr.attr == "environ":
+            return isinstance(expr.value, ast.Name) and expr.value.id in _OS_NAMES
+        return isinstance(expr, ast.Name) and expr.id == "environ"
+
+    def _lit(args):
+        if args and isinstance(args[0], ast.Constant) and isinstance(args[0].value, str):
+            return args[0].value
+        return None
+
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "get" and _is_environ(f.value):
+            return _lit(node.args)
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _ENV_FUNCS
+            and isinstance(f.value, ast.Name)
+            and f.value.id in _OS_NAMES
+        ):
+            return _lit(node.args)
+    if isinstance(node, ast.Subscript) and _is_environ(node.value):
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return sl.value
+    return None
+
+
+@register
+class EnvReadRule(Rule):
+    name = "env-read"
+    why = (
+        "raw os.environ reads of STENCIL_* knobs skip validation; use the "
+        "env_* helpers in utils/config.py so malformed values fail naming "
+        "the variable"
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        if rel == CONFIG_MODULE:
+            return False  # the one module allowed to touch os.environ
+        return rel.startswith("stencil_tpu/") or rel == "bench.py"
+
+    def check(self, ctx: FileContext) -> List[Violation]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            var = env_read_var(node)
+            if var is None or not var.startswith("STENCIL_"):
+                continue
+            out.append(
+                ctx.violation(
+                    self.name,
+                    node,
+                    f"raw environment read of {var!r} — use a validated "
+                    "helper from stencil_tpu/utils/config.py (env_int/"
+                    "env_float/env_bool/env_str/env_choice) so malformed "
+                    "values fail naming the variable",
+                )
+            )
+        return out

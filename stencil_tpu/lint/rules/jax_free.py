@@ -1,0 +1,84 @@
+"""Rule ``jax-import``: the modules documented as jax-free must not import
+jax at module level.
+
+The telemetry layer's contract (docs/observability.md) is that enabling
+metrics/events can never initialize a jax backend — a fresh process that
+only touches telemetry must stay backend-free (the fail-closed rank probe
+depends on it).  The resilience taxonomy and fault injector are consulted
+from exception handlers where jax may be mid-failure, and
+``utils/config.py`` is read at import time by everything.  Until this rule,
+"never imports jax" was a CHANGES.md claim verified only by a subprocess
+test for one module; now any module-level ``import jax`` /
+``from jax import ...`` in the declared-jax-free set fails the lint.
+Lazy in-function imports remain allowed (that is the sanctioned pattern —
+see telemetry/spans.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from stencil_tpu.lint.framework import FileContext, Rule, register
+
+#: declared-jax-free surface: prefixes and exact files (repo-relative)
+JAX_FREE_PREFIXES = ("stencil_tpu/telemetry/", "stencil_tpu/lint/")
+JAX_FREE_FILES = {
+    "stencil_tpu/resilience/taxonomy.py",
+    "stencil_tpu/resilience/inject.py",
+    "stencil_tpu/utils/config.py",
+}
+
+
+def _module_level_imports(tree: ast.Module):
+    """Import nodes executed at import time: anything not nested inside a
+    function/lambda body (class bodies and module-level if/try blocks all
+    execute on import)."""
+    in_function = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            for sub in ast.walk(node):
+                if sub is not node:
+                    in_function.add(id(sub))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)) and id(node) not in in_function:
+            yield node
+
+
+def _imports_jax(node) -> bool:
+    if isinstance(node, ast.Import):
+        return any(a.name == "jax" or a.name.startswith("jax.") for a in node.names)
+    if isinstance(node, ast.ImportFrom):
+        m = node.module or ""
+        return node.level == 0 and (m == "jax" or m.startswith("jax."))
+    return False
+
+
+@register
+class JaxFreeRule(Rule):
+    name = "jax-import"
+    why = (
+        "telemetry/, resilience/taxonomy|inject, utils/config.py and the "
+        "linter itself are contractually jax-free at import time; import "
+        "jax lazily inside the function that needs it"
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        return rel in JAX_FREE_FILES or rel.startswith(JAX_FREE_PREFIXES)
+
+    def check(self, ctx: FileContext) -> List:
+        out = []
+        for node in _module_level_imports(ctx.tree):
+            if _imports_jax(node):
+                out.append(
+                    ctx.violation(
+                        self.name,
+                        node,
+                        "module-level jax import in a declared-jax-free "
+                        "module — import jax lazily inside the function "
+                        "that needs it (telemetry must never initialize a "
+                        "backend; see docs/observability.md)",
+                    )
+                )
+        return out

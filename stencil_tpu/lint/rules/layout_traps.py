@@ -1,0 +1,163 @@
+"""Rules ``halo-set-in-loop`` and ``sliver-dus``: the PERF_NOTES layout
+traps, as checkable patterns.
+
+Measured on v5e (PERF_NOTES "Layout assignment traps" / "In-loop
+aliased-pallas chains"):
+
+* A ``.at[...].set`` halo write inside a ``fori_loop``/``scan`` body makes
+  XLA materialize full-domain copy+DUS fusions per iteration (probe12) —
+  the tile-local blend kernels in ``ops/halo_blend.py`` keep the chain
+  in-place.  ``halo-set-in-loop`` flags ``.at[...].set`` reachable from a
+  loop-body callable (lexically inside it, or in a same-file function the
+  body calls by name — best-effort, bounded-depth).
+* A y- or z-sliver ``dynamic_update_slice`` baits layout assignment into
+  transposing the WHOLE array ({2,1,0}->{2,0,1} relayout copies, 9.2 ms
+  per exchange at 518³ — probe6).  Whether a given DUS is a sliver is not
+  statically decidable, so ``sliver-dus`` flags every
+  ``dynamic_update_slice`` in the fast-path tree and asks the author to
+  either switch to a blend kernel or suppress with the reason the site is
+  contiguous/full-extent.
+
+``ops/halo_blend.py`` itself is exempt — it IS the sanctioned fix and its
+docstrings narrate the trap.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from stencil_tpu.lint import astutil
+from stencil_tpu.lint.framework import FileContext, Rule, register
+
+#: call-graph hops followed from a loop body when hunting .at[].set —
+#: bounded so a by-name resolution mistake cannot spider the whole file
+MAX_DEPTH = 4
+
+_EXEMPT = "stencil_tpu/ops/halo_blend.py"
+
+
+def _loop_body_roots(tree: ast.Module) -> List[ast.AST]:
+    """The callables passed as bodies to ``fori_loop``/``scan``/
+    ``while_loop``: lambda nodes directly, or same-file defs resolved by
+    bare name."""
+    defs = astutil.module_defs(tree)
+    roots: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = astutil.call_name(node)
+        if cn == "fori_loop":
+            cands = node.args[2:3]
+        elif cn == "scan":
+            cands = node.args[0:1]
+        elif cn == "while_loop":
+            cands = node.args[1:2]
+        else:
+            continue
+        kw = astutil.keyword(node, "body_fun") or astutil.keyword(node, "f")
+        if kw is not None:
+            cands = [kw]
+        for cand in cands:
+            if isinstance(cand, ast.Lambda):
+                roots.append(cand)
+            elif isinstance(cand, ast.Name):
+                roots.extend(defs.get(cand.id, []))
+    return roots
+
+
+def _reachable(roots: List[ast.AST], defs: Dict[str, List[ast.AST]]):
+    """Functions reachable from the loop bodies by same-file bare-name
+    calls (including functions passed onward as bare-name arguments),
+    depth-bounded."""
+    seen: Set[int] = set()
+    frontier = [(r, 0) for r in roots]
+    out = []
+    while frontier:
+        node, depth = frontier.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        out.append(node)
+        if depth >= MAX_DEPTH:
+            continue
+        for name in astutil.called_names(node):
+            for d in defs.get(name, []):
+                if id(d) not in seen:
+                    frontier.append((d, depth + 1))
+    return out
+
+
+@register
+class HaloSetInLoopRule(Rule):
+    name = "halo-set-in-loop"
+    why = (
+        "`.at[...].set` halo writes inside fori_loop/scan bodies "
+        "materialize full-domain copy+DUS fusions every iteration; use the "
+        "aliased blend kernels in ops/halo_blend.py (PERF_NOTES probe12)"
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        return rel.startswith("stencil_tpu/") and rel != _EXEMPT
+
+    def check(self, ctx: FileContext) -> List:
+        roots = _loop_body_roots(ctx.tree)
+        if not roots:
+            return []
+        defs = astutil.module_defs(ctx.tree)
+        out = []
+        seen_lines: Set[int] = set()
+        for fn in _reachable(roots, defs):
+            for node in ast.walk(fn):
+                if astutil.is_at_set_call(node) and node.lineno not in seen_lines:
+                    seen_lines.add(node.lineno)
+                    out.append(
+                        ctx.violation(
+                            self.name,
+                            node,
+                            ".at[...].set inside (or reachable from) a "
+                            "fori_loop/scan body — XLA materializes a "
+                            "full-domain copy+DUS fusion per iteration; "
+                            "write halos through the aliased kernels in "
+                            "ops/halo_blend.py, or suppress with the "
+                            "reason this buffer is small/off the fast "
+                            "path (PERF_NOTES: layout assignment traps)",
+                        )
+                    )
+        return out
+
+
+@register
+class SliverDusRule(Rule):
+    name = "sliver-dus"
+    why = (
+        "a y/z-sliver dynamic_update_slice makes XLA transpose the whole "
+        "array (9.2 ms/exchange at 518³, probe6); use ops/halo_blend.py "
+        "or state why the update is contiguous"
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        return rel.startswith("stencil_tpu/") and rel != _EXEMPT
+
+    def check(self, ctx: FileContext) -> List:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and astutil.call_name(node) == "dynamic_update_slice"
+            ):
+                out.append(
+                    ctx.violation(
+                        self.name,
+                        node,
+                        "dynamic_update_slice on the fast-path tree — a "
+                        "y/z-sliver update baits XLA layout assignment "
+                        "into relayout-copying the whole array; use the "
+                        "tile-local kernels in ops/halo_blend.py, or "
+                        "suppress stating why this update is contiguous "
+                        "(x-plane / full-extent) (PERF_NOTES probe6)",
+                    )
+                )
+        return out

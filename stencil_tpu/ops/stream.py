@@ -709,11 +709,13 @@ def make_slab_extenders(Xr: int, Yr: int, s: int, mesh_shape, axis_names=None):
     def yext(S):
         lo_ = _shift_from_low(S[:, :, Yr - 2 * s : Yr - s], names[1], mesh_shape[1])
         hi_ = _shift_from_high(S[:, :, s : 2 * s], names[1], mesh_shape[1])
+        # stencil-lint: disable=halo-set-in-loop writes land on the thin z-slab buffers (2s planes), not the full domain — slab extension IS the design that keeps z halos out of the big array (PERF_NOTES z-slabs)
         return S.at[:, :, 0:s].set(lo_).at[:, :, Yr - s : Yr].set(hi_)
 
     def xext(S):
         lo_ = _shift_from_low(S[Xr - 2 * s : Xr - s], names[0], mesh_shape[0])
         hi_ = _shift_from_high(S[s : 2 * s], names[0], mesh_shape[0])
+        # stencil-lint: disable=halo-set-in-loop same: x-extension of the thin z-slab buffers, sublane-cheap and off the big array
         return S.at[0:s].set(lo_).at[Xr - s : Xr].set(hi_)
 
     return yext, xext
@@ -817,6 +819,7 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
             if rem:
                 bs = one(rem, bs)
             return tuple(
+                # stencil-lint: disable=sliver-dus whole-interior write-back after the wrap loop — b spans the full interior, not a y/z sliver
                 lax.dynamic_update_slice(rb, b, (lo.x, lo.y, lo.z))
                 for rb, b in zip(blocks_raw, bs)
             )

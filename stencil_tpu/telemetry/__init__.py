@@ -32,7 +32,9 @@ Design rules (enforced here, asserted by tests):
   ``logging._rank`` probe; spans enter ``jax.named_scope`` only when jax is
   already imported.
 * **no free-string names** — call sites name series through
-  ``telemetry.names`` constants; ``scripts/check_telemetry_names.py`` lints.
+  ``telemetry.names`` constants; the ``telemetry-name`` rule of
+  ``stencil_tpu.lint`` enforces it (and ``jax-import`` enforces the
+  backend-free contract above).
 """
 
 from __future__ import annotations

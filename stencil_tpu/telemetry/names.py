@@ -3,8 +3,9 @@ and event name this tree may emit.
 
 Every call into the telemetry facade (``telemetry.inc`` / ``observe`` /
 ``set_gauge`` / ``emit_event`` / ``span`` / ``record_span``) must name its
-series through a constant defined here; ``scripts/check_telemetry_names.py``
-(wired as a tier-1 test) rejects free-string names at call sites.  One
+series through a constant defined here; the ``telemetry-name`` rule of
+``stencil_tpu.lint`` (wired as a tier-1 test) rejects free-string names at
+call sites.  One
 module of constants keeps the cross-round BENCH diffs stable: a renamed or
 typo'd series fails the lint instead of silently forking the time series.
 

@@ -32,6 +32,7 @@ def _parse_level(raw: str) -> int:
         return INFO
 
 
+# stencil-lint: disable=env-read import-time level parse: a logging import must never crash, so malformed values warn-and-default instead of raising like the env_* helpers do
 _LEVEL = _parse_level(os.environ.get("STENCIL_OUTPUT_LEVEL", "INFO"))
 
 

@@ -1,0 +1,40 @@
+# lint-fixture: select=donated-reuse rel=stencil_tpu/fake.py expect=clean
+# The sanctioned patterns: rebinding through the result, liveness-guarded
+# scopes, attribute-held buffers (runtime guard's job), non-donating jits.
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=0)
+def step(x):
+    return x + 1
+
+
+@partial(jax.jit, static_argnums=1)
+def plain(x, n):
+    return x * n
+
+
+def swap_loop(x0, steps):
+    for _ in range(steps):
+        x0 = step(x0)  # canonical swap: every read sees the fresh buffer
+    return x0
+
+
+def guarded_retry(x0):
+    y = step(x0)
+    if not x0.is_deleted():  # the resilience/retry.py liveness guard
+        y = y + x0
+    return y
+
+
+def non_donating(x0):
+    y = plain(x0, 2)
+    return x0.sum() + y  # plain jit without donation: reuse is fine
+
+
+class Holder:
+    def run(self):
+        self.curr = step(self.curr)  # attribute dataflow: runtime guard's job
+        return self.curr

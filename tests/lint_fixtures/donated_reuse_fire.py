@@ -1,0 +1,44 @@
+# lint-fixture: select=donated-reuse rel=stencil_tpu/fake.py expect=donated-reuse,donated-reuse,donated-reuse,bad-suppression
+# Seeded violations: reading a binding after donating it — through a
+# partial(jax.jit, donate_argnums=...) def and through a pallas_call with
+# input_output_aliases.  A reasoned suppression silences a third case; a
+# bare suppression fails (its site is rebound, so only the comment fires).
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+@partial(jax.jit, donate_argnums=0)
+def step(x):
+    return x + 1
+
+
+def bad_reuse(x0):
+    y = step(x0)
+    return x0.sum() + y  # x0's buffer may already be freed
+
+
+inplace = pl.pallas_call(lambda ref, o: None, input_output_aliases={0: 0})
+
+
+def bad_alias_reuse(buf):
+    out = inplace(buf)
+    return buf[0], out  # aliased input rewritten in place
+
+
+def suppressed_reuse(x0):
+    y = step(x0)
+    # stencil-lint: disable=donated-reuse fixture: reasoned suppression silences the reuse below
+    return x0.shape, y
+
+
+def bad_same_line_reuse(x0):
+    return step(x0), x0.shape  # reuse on the call's own line still counts
+
+
+def rebound_ok(x0):
+    # stencil-lint: disable=donated-reuse
+    x0 = step(x0)
+    return x0.sum()  # rebound through the result: reads see the fresh buffer

@@ -1,0 +1,6 @@
+# lint-fixture: select=env-read rel=stencil_tpu/fake.py expect=clean
+# The sanctioned pattern: STENCIL_* knobs go through the validated helpers.
+from stencil_tpu.utils.config import env_bool, env_int
+
+DEPTH = env_int("STENCIL_FAKE_DEPTH", 16, minimum=1)
+ALIAS = env_bool("STENCIL_FAKE_ALIAS", False)

@@ -1,0 +1,14 @@
+# lint-fixture: select=env-read rel=stencil_tpu/fake.py expect=env-read,env-read,env-read,bad-suppression
+# Seeded violations: raw STENCIL_* read forms fire; a reasoned suppression
+# silences its read; a bare suppression fails AND leaves its read flagged.
+# Non-STENCIL names are out of scope.
+import os
+from os import environ
+
+A = os.environ.get("STENCIL_NEW_KNOB", "1")
+B = os.environ["STENCIL_OTHER"]
+# stencil-lint: disable=env-read
+C = environ.get("STENCIL_BARE_FORM")
+# stencil-lint: disable=env-read fixture: reasoned suppression silences the read below
+D = os.getenv("STENCIL_SUPPRESSED")
+ok = os.environ.get("JAX_PLATFORMS")

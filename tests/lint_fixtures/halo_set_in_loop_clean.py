@@ -1,0 +1,12 @@
+# lint-fixture: select=halo-set-in-loop rel=stencil_tpu/fake.py expect=clean
+# .at[].set outside any loop body is fine (one-shot init writes), and loop
+# bodies that stay off indexed updates are fine.
+from jax import lax
+
+
+def init(block, vals):
+    return block.at[0:2].set(vals)  # not under a fori_loop/scan body
+
+
+def run(block, steps):
+    return lax.fori_loop(0, steps, lambda _, b: b + 1, block)

@@ -1,0 +1,25 @@
+# lint-fixture: select=halo-set-in-loop rel=stencil_tpu/fake.py expect=halo-set-in-loop,halo-set-in-loop,bad-suppression
+# Seeded violations: .at[].set lexically inside a fori_loop body (via a
+# lambda) and inside a helper the body calls by name.  A reasoned
+# suppression silences a third site; a bare suppression fails.
+from jax import lax
+
+
+def write_halo(b, lo_):
+    return b.at[:, :, 0:2].set(lo_)
+
+
+def suppressed_write(b, hi_):
+    # stencil-lint: disable=halo-set-in-loop fixture: reasoned suppression silences the write below
+    return b.at[:, :, -2:].set(hi_)
+
+
+def run(block, steps, lo_, hi_):
+    def body(_, b):
+        b = b.at[0:2].set(lo_)  # lexically in the body
+        b = write_halo(b, lo_)  # via a called helper
+        b = suppressed_write(b, hi_)
+        return b
+
+    # stencil-lint: disable=halo-set-in-loop
+    return lax.fori_loop(0, steps, body, block)

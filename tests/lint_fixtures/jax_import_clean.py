@@ -1,0 +1,14 @@
+# lint-fixture: select=jax-import rel=stencil_tpu/telemetry/fake.py expect=clean
+# The sanctioned lazy pattern (telemetry/spans.py): jax only inside the
+# function that needs it, or fished out of sys.modules without importing.
+import sys
+
+
+def annotate(name):
+    import jax
+
+    return jax.named_scope(name)
+
+
+def maybe():
+    return sys.modules.get("jax")

@@ -1,0 +1,19 @@
+# lint-fixture: select=jax-import rel=stencil_tpu/telemetry/fake.py expect=jax-import,jax-import,jax-import,bad-suppression
+# Seeded violations: module-level jax imports in a declared-jax-free module
+# (both forms); one more under a reasoned suppression is silenced; a bare
+# suppression fails.
+import jax
+from jax import numpy as jnp
+
+# stencil-lint: disable=jax-import fixture: reasoned suppression silences the import below
+import jax.numpy
+# stencil-lint: disable=jax-import
+import jax.tree_util
+
+import os  # non-jax module-level imports are fine
+
+
+def lazy():
+    import jax  # in-function: the sanctioned lazy pattern
+
+    return jax

@@ -1,0 +1,16 @@
+# lint-fixture: select=sliver-dus rel=stencil_tpu/fake.py expect=sliver-dus,bad-suppression
+# Seeded violation: a dynamic_update_slice on the fast-path tree; a
+# reasoned suppression (whole-interior write-back) silences a second; a
+# bare suppression fails.
+from jax import lax
+
+
+def bad(b, sliver):
+    return lax.dynamic_update_slice(b, sliver, (0, 0, 510))
+
+
+def ok(raw, block, lo):
+    # stencil-lint: disable=sliver-dus fixture: whole-interior write-back, not a y/z sliver
+    out = lax.dynamic_update_slice(raw, block, (lo.x, lo.y, lo.z))
+    # stencil-lint: disable=sliver-dus
+    return out

@@ -1,0 +1,27 @@
+# lint-fixture: select=slow-marker rel=tests/test_fake.py expect=clean
+# Marked tests pass (function and class markers), docstring mentions of
+# bench.py are not invocations, and in-process tests never trigger.
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_spawn_marked():
+    assert subprocess.run([sys.executable, "-c", "pass"]).returncode == 0
+
+
+@pytest.mark.slow
+class TestHeavy:
+    def test_spawn_in_marked_class(self):
+        subprocess.run([sys.executable, "-c", "pass"])
+
+
+def test_docstring_mention_only():
+    """Numbers here are cross-checked against bench.py's protocol."""
+    assert 1 + 1 == 2
+
+
+def test_in_process():
+    assert sys.maxsize > 0

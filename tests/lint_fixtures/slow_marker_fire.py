@@ -1,0 +1,42 @@
+# lint-fixture: select=slow-marker rel=tests/test_fake.py expect=slow-marker,slow-marker,slow-marker,bad-suppression
+# Seeded violations: unmarked tests that spawn sys.executable directly,
+# spawn through a module-local helper, and invoke bench.py.  A reasoned
+# suppression silences a fourth; a bare suppression fails on a marked test.
+import subprocess
+import sys
+
+import pytest
+
+
+def _spawn(code):
+    return subprocess.run([sys.executable, "-c", code], capture_output=True)
+
+
+def test_direct_spawn():
+    assert subprocess.run([sys.executable, "-c", "pass"]).returncode == 0
+
+
+def test_helper_spawn():
+    assert _spawn("pass").returncode == 0
+
+
+def test_runs_bench(tmp_path):
+    proc = subprocess.run([sys.executable, "bench.py"], capture_output=True)
+    assert proc.returncode == 0
+
+
+# stencil-lint: disable=slow-marker fixture: reasoned suppression — the child is a jax-free sub-second probe
+def test_cheap_child_suppressed():
+    assert _spawn("pass").returncode == 0
+
+
+# stencil-lint: disable=slow-marker
+@pytest.mark.slow
+def test_marked_with_pointless_bare_suppression():
+    assert _spawn("pass").returncode == 0
+
+
+# stencil-lint: disable=slow-marker fixture: the finding anchors at the first decorator, so this suppression covers a decorated test
+@pytest.mark.filterwarnings("ignore")
+def test_decorated_suppressed():
+    assert _spawn("pass").returncode == 0

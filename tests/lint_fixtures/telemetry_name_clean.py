@@ -1,0 +1,7 @@
+# lint-fixture: select=telemetry-name rel=stencil_tpu/fake.py expect=clean
+# The sanctioned pattern: every series name is a registered constant.
+from stencil_tpu import telemetry
+from stencil_tpu.telemetry import names as tm
+
+telemetry.inc(tm.RETRY_ATTEMPTS)
+telemetry.emit_event(tm.EVENT_RETRY, label="fixture")

@@ -1,0 +1,13 @@
+# lint-fixture: select=telemetry-name rel=stencil_tpu/fake.py expect=telemetry-name,telemetry-name,bad-suppression
+# Seeded violations: a free-string series name at a facade call and a
+# typo'd names.* constant; a reasoned suppression silences a second free
+# string; a bare suppression fails.
+from stencil_tpu import telemetry
+from stencil_tpu.telemetry import names
+
+telemetry.inc("my.unregistered.counter")
+print(names.NO_SUCH_CONSTANT)
+# stencil-lint: disable=telemetry-name fixture: reasoned suppression silences the call below
+telemetry.inc("another.unregistered.counter")
+telemetry.inc(names.RETRY_ATTEMPTS)  # registered constant: fine
+# stencil-lint: disable=telemetry-name

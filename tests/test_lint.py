@@ -1,0 +1,220 @@
+"""Tier-1: the stencil-lint framework and its full rule set — all
+in-process (no child interpreters, no device work; the whole file runs in
+milliseconds-to-seconds).
+
+This is THE lint gate: ``test_tree_is_clean`` replaces the two scattered
+script tests (``test_tune.py::test_env_read_lint`` and
+``test_telemetry.py::test_names_lint``) with one run of every rule over
+the default surface, and the fixture corpus under ``tests/lint_fixtures/``
+proves each rule fires on a seeded violation, that a suppression with a
+reason silences it, and that a bare suppression fails.
+"""
+
+import glob
+import json
+import os
+import re
+
+import pytest
+
+from stencil_tpu import lint
+from stencil_tpu.lint import framework
+from stencil_tpu.lint.cli import main as lint_main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURE_DIR = os.path.join(HERE, "lint_fixtures")
+FIXTURES = sorted(glob.glob(os.path.join(FIXTURE_DIR, "*.py")))
+
+_HEADER = re.compile(
+    r"#\s*lint-fixture:\s*select=(\S+)\s+rel=(\S+)\s+expect=(\S+)"
+)
+
+
+def _parse_header(path):
+    with open(path) as fh:
+        first = fh.readline()
+    m = _HEADER.match(first)
+    assert m, f"{path}: first line must be a lint-fixture header"
+    select = m.group(1).split(",")
+    rel = m.group(2)
+    expect = [] if m.group(3) == "clean" else m.group(3).split(",")
+    return select, rel, sorted(expect)
+
+
+# --- the gate ----------------------------------------------------------------
+
+
+def test_tree_is_clean():
+    """Every rule over the whole checked surface: the shipped tree carries
+    no violations (fixed or suppressed-with-reason) and no rotted
+    suppressions."""
+    violations = lint.run_lint()
+    assert not violations, "\n".join(v.render() for v in violations)
+
+
+# --- fixture corpus: every rule fires and suppresses -------------------------
+
+
+@pytest.mark.parametrize(
+    "path", FIXTURES, ids=[os.path.basename(p)[:-3] for p in FIXTURES]
+)
+def test_fixture(path):
+    select, rel, expect = _parse_header(path)
+    with open(path) as fh:
+        source = fh.read()
+    got = lint.lint_source(source, rel=rel, select=select)
+    assert sorted(v.rule for v in got) == expect, "\n".join(
+        v.render() for v in got
+    )
+
+
+def test_every_rule_has_fire_and_clean_fixtures():
+    """The corpus cannot rot: each registered rule keeps a fixture that
+    fires it and a fixture proving its sanctioned pattern stays clean."""
+    names = {cls.name for cls in lint.all_rules()}
+    fired, cleaned = set(), set()
+    for path in FIXTURES:
+        select, _, expect = _parse_header(path)
+        for rule in select:
+            (fired if rule in expect else cleaned).add(rule)
+    assert fired == names, f"rules without a firing fixture: {names - fired}"
+    assert cleaned == names, f"rules without a clean fixture: {names - cleaned}"
+
+
+# stencil-lint: disable=slow-marker asserts on the bench file's NAME in the default surface; nothing is spawned
+def test_fixture_corpus_excluded_from_default_scope():
+    files = lint.default_files()
+    assert files, "default surface is empty?"
+    rels = [os.path.relpath(p, framework.REPO) for p in files]
+    assert not any("lint_fixtures" in r for r in rels)
+    assert not any(r.startswith(os.path.join("scripts", "probes")) for r in rels)
+    assert "bench.py" in rels
+    assert os.path.join("scripts", "check_env_reads.py") in rels
+
+
+# --- suppression grammar -----------------------------------------------------
+
+
+SUPP = "# stencil-lint: "  # assembled so this file never carries the pattern
+
+
+def test_unused_suppression_is_flagged():
+    src = SUPP + "disable=env-read this read was removed long ago\nX = 1\n"
+    got = lint.lint_source(src, rel="stencil_tpu/fake.py", select=["env-read"])
+    assert [v.rule for v in got] == [framework.SUPPRESSION_RULE]
+    assert "unused" in got[0].message
+
+
+def test_unknown_rule_in_suppression_is_flagged():
+    src = SUPP + "disable=no-such-rule because reasons\nX = 1\n"
+    got = lint.lint_source(src, rel="stencil_tpu/fake.py", select=["env-read"])
+    assert [v.rule for v in got] == [framework.SUPPRESSION_RULE]
+    assert "unknown rule" in got[0].message
+
+
+def test_suppression_not_checked_for_rules_that_did_not_run():
+    """A suppression for a rule outside --select must not be reported as
+    unused — partial runs (pre-commit --select) would otherwise lie."""
+    src = SUPP + "disable=sliver-dus whole-interior write-back\nX = 1\n"
+    got = lint.lint_source(src, rel="stencil_tpu/fake.py", select=["env-read"])
+    assert got == []
+
+
+def test_suppression_quoted_in_string_is_not_parsed():
+    """Only real COMMENT tokens are suppressions — a docstring or string
+    literal quoting the syntax must neither silence nor be flagged as an
+    unused suppression."""
+    quoted = 'DOC = "syntax: ' + SUPP + 'disable=env-read <reason>"\n'
+    got = lint.lint_source(quoted, rel="stencil_tpu/fake.py",
+                           select=["env-read"])
+    assert got == []
+
+
+def test_excluded_dirs_match_exact_prefixes_only():
+    """'scripts/probes' must not exclude an unrelated dir that happens to
+    share a basename (e.g. a future stencil_tpu/probes/ subpackage)."""
+    assert framework._excluded(os.path.join("scripts", "probes", "p.py"))
+    assert framework._excluded(os.path.join("tests", "lint_fixtures", "f.py"))
+    assert framework._excluded(os.path.join("stencil_tpu", "__pycache__", "x.pyc"))
+    assert not framework._excluded(os.path.join("stencil_tpu", "probes", "x.py"))
+    assert not framework._excluded(os.path.join("tests", "test_probes.py"))
+
+
+def test_syntax_error_is_reported_not_raised():
+    got = lint.lint_source("def broken(:\n", rel="stencil_tpu/fake.py")
+    assert len(got) == 1 and "does not parse" in got[0].message
+    assert got[0].rule == framework.SYNTAX_RULE  # not conflated with others
+
+
+def test_suppression_covers_wrapped_statement():
+    """A standalone comment above a statement covers its continuation
+    lines too — a wrapped call anchors the violation below the comment."""
+    src = (
+        "import os\n"
+        + SUPP
+        + "disable=env-read wrapped call, continuation lines covered\n"
+        "VAL = str(\n"
+        '    os.environ.get("STENCIL_WRAPPED")\n'
+        ")\n"
+    )
+    got = lint.lint_source(src, rel="stencil_tpu/fake.py", select=["env-read"])
+    assert got == []
+
+
+# --- engine / CLI ------------------------------------------------------------
+
+
+def test_select_unknown_rule_raises():
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint.run_lint(
+            paths=[os.path.join(framework.REPO, "stencil_tpu", "__init__.py")],
+            select=["nope"],
+        )
+
+
+def test_cli_list_rules_and_exit_codes(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for cls in lint.all_rules():
+        assert cls.name in out
+        assert cls.why  # every rule documents its rationale
+    assert lint_main(["--select", "nope"]) == 2
+    assert lint_main(["/nonexistent/typo.py"]) == 2  # path typo ≠ violations
+
+
+def test_cli_json_shape(capsys):
+    path = os.path.join(framework.REPO, "stencil_tpu", "utils", "logging.py")
+    assert lint_main(["--json", path]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["count"] == 0 and doc["files_checked"] == 1
+    assert set(doc) == {"violations", "count", "files_checked", "rules"}
+    assert sorted(c.name for c in lint.all_rules()) == doc["rules"]
+
+
+def test_changed_only_subset():
+    changed = framework.changed_files()
+    assert set(changed) <= set(lint.default_files())
+
+
+def test_rule_ids_are_kebab_case():
+    for cls in lint.all_rules():
+        assert re.fullmatch(r"[a-z][a-z0-9-]+", cls.name), cls.name
+
+
+# --- legacy shims ------------------------------------------------------------
+
+
+def test_legacy_scripts_are_thin_shims():
+    """The two historical checker scripts delegate to the framework — no
+    duplicated rule logic left behind."""
+    for script, rule in (
+        ("check_env_reads.py", "env-read"),
+        ("check_telemetry_names.py", "telemetry-name"),
+    ):
+        src = open(os.path.join(framework.REPO, "scripts", script)).read()
+        assert "stencil_tpu.lint" in src
+        assert "def check_file" not in src  # the old inline implementation
+        assert rule in src
+    # and the rules they point at still pass standalone --select runs
+    assert lint.run_lint(select=["env-read"]) == []
+    assert lint.run_lint(select=["telemetry-name"]) == []

@@ -27,12 +27,14 @@ CODE = (
 )
 
 
+# stencil-lint: disable=slow-marker jax-free `python -c` child importing only utils.logging (~0.1s); level parsing happens at import so a fresh interpreter is the only honest probe
 def test_symbolic_name_accepted():
     r = _run("SPEW", CODE)
     assert r.returncode == 0
     assert "SPEW" in r.stderr and "INFO" in r.stderr and "ERROR" in r.stderr
 
 
+# stencil-lint: disable=slow-marker jax-free `python -c` child importing only utils.logging (~0.1s); level parsing happens at import so a fresh interpreter is the only honest probe
 def test_higher_is_more_verbose():
     r = _run("5", CODE)  # SPEW: everything prints
     assert "SPEW" in r.stderr
@@ -40,17 +42,20 @@ def test_higher_is_more_verbose():
     assert "SPEW" not in r.stderr and "INFO" not in r.stderr and "ERROR" in r.stderr
 
 
+# stencil-lint: disable=slow-marker jax-free `python -c` child importing only utils.logging (~0.1s); level parsing happens at import so a fresh interpreter is the only honest probe
 def test_default_is_info():
     r = _run(None, CODE)  # env var absent: default must be INFO
     assert "INFO" in r.stderr and "SPEW" not in r.stderr
 
 
+# stencil-lint: disable=slow-marker jax-free `python -c` child importing only utils.logging (~0.1s); level parsing happens at import so a fresh interpreter is the only honest probe
 def test_garbage_level_does_not_crash_import():
     r = _run("bogus", CODE)
     assert r.returncode == 0
     assert "unrecognized" in r.stderr
 
 
+# stencil-lint: disable=slow-marker jax-free `python -c` child importing only utils.logging (~0.1s); level parsing happens at import so a fresh interpreter is the only honest probe
 def test_timestamps_opt_in():
     """STENCIL_LOG_TIMESTAMPS=1 prefixes an ISO-8601 UTC timestamp (so log
     lines correlate with telemetry JSONL event ``ts`` fields); default

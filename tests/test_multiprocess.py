@@ -24,6 +24,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_exchange_and_coordination():
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "mp_worker.py")

@@ -539,6 +539,7 @@ def test_axis_edge_kinds_scans_all_lines():
 # --- bench driver: artifact survives an astaroth-section failure ------------
 
 
+# stencil-lint: disable=slow-marker runs bench.py at size 16 in interpret mode on CPU — 7s measured; artifact-survival is PR-1's headline acceptance and must stay in the tier-1 gate
 def test_bench_artifact_survives_injected_transient():
     """The acceptance scenario that killed BENCH_r05.json: a transient
     remote-compile failure during the astaroth section of ``python bench.py``

@@ -448,6 +448,7 @@ def test_bench_json_grows_telemetry_section(tmp_path):
     assert (tmp_path / "trace_0.json").exists()
 
 
+# stencil-lint: disable=slow-marker reads bench.py's SOURCE for the guard string; never spawns it (the docstring says why)
 def test_bench_disabled_writes_no_telemetry_key():
     """The disabled default: no telemetry key in the artifact and no files.
     Checked on the source, not a second full bench run (cost)."""
@@ -455,41 +456,7 @@ def test_bench_disabled_writes_no_telemetry_key():
     assert "telemetry.enabled()" in src  # guarded, not unconditional
 
 
-# --- canonical-names lint ----------------------------------------------------
-
-
-def test_names_lint():
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "scripts", "check_telemetry_names.py")],
-        capture_output=True,
-        text=True,
-        timeout=120,
-    )
-    assert proc.returncode == 0, proc.stderr
-
-
-def test_names_lint_catches_free_strings(tmp_path):
-    """The lint must actually reject an unregistered literal at a telemetry
-    call site (checked through its module API on a synthetic file)."""
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    try:
-        import check_telemetry_names as lint
-    finally:
-        sys.path.pop(0)
-    bad = tmp_path / "bad.py"
-    bad.write_text(
-        "from stencil_tpu import telemetry\n"
-        "telemetry.inc('my.unregistered.counter')\n"
-        "from stencil_tpu.telemetry import names\n"
-        "print(names.NO_SUCH_CONSTANT)\n"
-    )
-    all_names, constants = lint._registered_names()
-    problems = lint.check_file(str(bad), all_names, constants)
-    assert len(problems) == 2
-    assert "free-string" in problems[0]
-    assert "NO_SUCH_CONSTANT" in problems[1]
-
-
+# stencil-lint: disable=slow-marker the no-backend-init contract is only provable in a fresh interpreter; the child imports telemetry (jax-free) and exits in ~1s
 def test_telemetry_never_initializes_backend():
     """A metrics/event call in a fresh process must not bring a jax backend
     up (the logging._rank fail-closed rule extends to telemetry)."""

@@ -492,36 +492,6 @@ def test_driver_tune_flags(tune_dir, tmp_path):
         p.parse_args(["--tune", "--no-tune"])
 
 
-# --- lints -------------------------------------------------------------------
-
-
-def test_env_read_lint():
-    res = subprocess.run(
-        [sys.executable, os.path.join(REPO, "scripts", "check_env_reads.py")],
-        capture_output=True, text=True,
-    )
-    assert res.returncode == 0, res.stderr
-
-
-def test_env_read_lint_catches_raw_reads(tmp_path):
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    try:
-        import check_env_reads as lint
-    finally:
-        sys.path.pop(0)
-    bad = tmp_path / "bad.py"
-    bad.write_text(
-        "import os\n"
-        "A = os.environ.get('STENCIL_NEW_KNOB', '1')\n"
-        "B = os.environ['STENCIL_OTHER']\n"
-        "C = os.getenv('STENCIL_THIRD')\n"
-        "ok = os.environ.get('JAX_PLATFORMS')\n"
-    )
-    problems = lint.check_file(str(bad))
-    assert len(problems) == 3
-    assert all("validated helper" in p for p in problems)
-
-
 # --- tier-2: the bench acceptance path ---------------------------------------
 
 

@@ -104,6 +104,27 @@ def add_tune_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def add_exchange_route_flag(p: argparse.ArgumentParser) -> None:
+    """``--exchange-route``: pin the halo exchange's z-sweep route for this
+    run (docs/tuning.md "Exchange routes").  ``auto`` (default) keeps the
+    planner resolution: ``STENCIL_EXCHANGE_ROUTE`` > tuned config > the
+    static ``direct`` fallback."""
+    p.add_argument(
+        "--exchange-route",
+        default="auto",
+        choices=("auto", "direct", "zpack_xla", "zpack_pallas"),
+        help="z-sweep exchange route: direct slabs vs the packed z-shell "
+        "message (auto = env > tuned config > direct)",
+    )
+
+
+def apply_exchange_route(args, dd) -> None:
+    """Apply ``add_exchange_route_flag``'s choice to a pre-realize domain."""
+    route = getattr(args, "exchange_route", "auto")
+    if route != "auto":
+        dd.set_exchange_route(route)
+
+
 def tune_begin(args) -> None:
     """Apply the ``add_tune_flags`` choices to the tune facade; call right
     after ``parse_args`` (before any model/planner construction).  Pair

@@ -1,4 +1,4 @@
-"""bench-exchange — microbenchmark sweep of radius shapes.
+"""bench-exchange — microbenchmark sweep of radius shapes + route A/B.
 
 Parity target: reference bin/bench_exchange.cu: on a global compute-domain
 extent (default 128^3, bench_exchange.cu:21,84 — ``fit_to_mesh`` rescales it
@@ -8,11 +8,21 @@ configurations — +x-only, ±x, faces-only, faces+edges(eR), uniform —
 and report the reference's exact CSV (bench_exchange.cu:57-64):
 
     name,count,trimean (S),trimean (B/s),stddev,min,avg,max
+
+Beyond the reference: ``--route`` pins the z-sweep exchange route
+(ops/exchange.py ``EXCHANGE_ROUTES``) for the sweep, and a direct-vs-packed
+A/B section measures every engageable route under the burst-aware protocol
+(``tune.trial.measure_alternating``: alternate within one process, drop the
+post-idle-burst rep 0, steady-state median) with a per-axis (x/y/z) ms
+breakdown — so the ~64×-amplified thin-z claim (PERF_NOTES "Thin z-region
+access") is re-measurable per chip generation.  The section is emitted as
+one machine-readable JSON line on stdout (the bench.py convention).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 
@@ -25,17 +35,25 @@ from stencil_tpu.core.radius import Radius
 from stencil_tpu.domain import DistributedDomain
 from stencil_tpu.utils.statistics import Statistics
 
+#: sweep axes of the per-axis breakdown, exchange-axis index by name
+_AXES = {"x": 0, "y": 1, "z": 2}
 
-def bench(n_iters: int, n_quants: int, ext, radius: Radius, inner: int = 1, rt: float = 0.0):
-    """One config: returns (Statistics of per-iter seconds, exchanged bytes).
+
+def bench(n_iters: int, n_quants: int, ext, radius: Radius, inner: int = 1,
+          rt: float = 0.0, route: str = None):
+    """One config: returns (Statistics of per-iter seconds, exchanged bytes
+    per the 26-message model, swept wire bytes).
 
     ``inner > 1`` runs that many exchanges per device dispatch
     (``exchange_many``) and divides, with the measured host round trip ``rt``
     subtracted — the honest protocol for tunneled backends where a per-call
-    sync costs ~100 ms (see bench.py)."""
+    sync costs ~100 ms (see bench.py).  ``route`` pins the z-sweep exchange
+    route (None = planner resolution)."""
     x, y, z = _common.fit_to_mesh(ext[0], ext[1], ext[2], radius)
     dd = DistributedDomain(x, y, z)
     dd.set_radius(radius)
+    if route is not None:
+        dd.set_exchange_route(route)
     for i in range(n_quants):
         dd.add_data(f"d{i}", dtype=jnp.float32)
     dd.realize()
@@ -109,6 +127,108 @@ def sweep_configs(ext, fR: int, eR: int):
     yield f"{tag}/uniform/2", Radius.constant(2)
 
 
+def route_ab(ext, fR: int, n_quants: int, reps: int, rt: float, inner: int = 4) -> dict:
+    """Direct-vs-packed steady-state A/B at the uniform radius — every
+    engageable route's full exchange plus its per-axis (x/y/z) sweeps, all
+    alternating in ONE process under the trial protocol (rep-0 drop,
+    steady-state median).  Returns the JSON section."""
+    from jax import lax
+    from functools import partial
+
+    from stencil_tpu.ops.exchange import EXCHANGE_ROUTES, zpack_supported
+    from stencil_tpu.tune.runners import _force_done
+    from stencil_tpu.tune.trial import measure_alternating
+
+    radius = Radius.constant(fR)
+    x, y, z = _common.fit_to_mesh(ext[0], ext[1], ext[2], radius)
+    dd = DistributedDomain(x, y, z)
+    dd.set_radius(radius)
+    for i in range(n_quants):
+        dd.add_data(f"d{i}", dtype=jnp.float32)
+    dd.realize()
+    routes = ["direct"]
+    packed_ok = zpack_supported(
+        [h.dtype for h in dd._handles], dd._valid_last
+    )
+    if packed_ok:
+        routes += [r for r in EXCHANGE_ROUTES if r != "direct"]
+
+    def make_run(fn):
+        @partial(jax.jit, static_argnums=1)
+        def many(arrays, s):
+            return lax.fori_loop(0, s, lambda _, a: fn(a), arrays)
+
+        def run(n):
+            out = many(dd._curr, n)
+            _force_done(next(iter(out.values())))
+
+        return run
+
+    labels, runs = [], []
+    for route in routes:
+        labels.append((route, "all"))
+        runs.append(make_run(dd.make_exchange_route_fn(route, donate=False)))
+        # the routes differ ONLY in the z sweep (halo_exchange_multi engages
+        # _zpack_sweep at axis 2 alone): x/y per-axis runs would compile
+        # byte-identical programs per route, so they are measured once under
+        # direct and shared into every route's breakdown below
+        axes = _AXES.items() if route == "direct" else [("z", _AXES["z"])]
+        for ax_name, ax in axes:
+            labels.append((route, ax_name))
+            runs.append(
+                make_run(
+                    dd.make_exchange_route_fn(route, donate=False, axes=(ax,))
+                )
+            )
+    # calibrate the dispatch size once on the first run (shared workload —
+    # one inner count keeps rounds comparable), re-warm the rest at it
+    _, inner = _common.timed_inner_loop(runs[0], inner, rt, 1)
+    for run in runs[1:]:
+        run(inner)
+    rounds = measure_alternating(runs, inner, rt, reps)
+    import statistics as _st
+
+    section: dict = {
+        "fit_extent": [x, y, z],
+        "radius": fR,
+        "quantities": n_quants,
+        "packed_eligible": packed_ok,
+        "measurement_protocol": {
+            "alternating_within_process": True,
+            "drop_rep0": True,
+            "statistic": "median",
+            "reps": reps,
+            "inner": inner,
+        },
+        "routes": {},
+    }
+    for (route, part), samples in zip(labels, rounds):
+        entry = section["routes"].setdefault(
+            route, {"ms_per_exchange": None, "per_axis_ms": {}}
+        )
+        ms = _st.median(samples) * 1e3
+        if part == "all":
+            entry["ms_per_exchange"] = ms
+        else:
+            entry["per_axis_ms"][part] = ms
+    # packed routes share direct's x/y figures (identical programs; only z
+    # was measured per route) — the flag records the provenance
+    section["measurement_protocol"]["xy_shared_with_direct"] = True
+    for route, entry in section["routes"].items():
+        if route != "direct":
+            for ax_name in ("x", "y"):
+                entry["per_axis_ms"].setdefault(
+                    ax_name, section["routes"]["direct"]["per_axis_ms"][ax_name]
+                )
+    direct = section["routes"]["direct"]["ms_per_exchange"]
+    section["speedup_vs_direct"] = {
+        route: (direct / e["ms_per_exchange"]) if e["ms_per_exchange"] else None
+        for route, e in section["routes"].items()
+        if route != "direct"
+    }
+    return section
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("bench-exchange")
     p.add_argument("--iters", type=int, default=30)
@@ -118,6 +238,22 @@ def main(argv=None) -> int:
     p.add_argument("--z", type=int, default=128)
     p.add_argument("--face-radius", type=int, default=2, dest="fR")
     p.add_argument("--edge-radius", type=int, default=1, dest="eR")
+    p.add_argument(
+        "--route",
+        default="auto",
+        choices=("auto", "direct", "zpack_xla", "zpack_pallas"),
+        help="z-sweep exchange route for the CSV sweep (auto = planner "
+        "resolution: env > tuned config > direct; see docs/tuning.md "
+        "'Exchange routes')",
+    )
+    p.add_argument(
+        "--ab-reps",
+        type=int,
+        default=3,
+        metavar="N",
+        help="steady-state reps for the direct-vs-packed route A/B section "
+        "(alternating protocol, rep 0 dropped; 0 skips the section)",
+    )
     p.add_argument(
         "--inner",
         type=int,
@@ -147,12 +283,29 @@ def main(argv=None) -> int:
     if args.inner == 1:
         rt = 0.0
     ext = (args.x, args.y, args.z)
+    route = None if args.route == "auto" else args.route
     if jax.process_index() == 0:
         print(report_header())
     for name, radius in sweep_configs(ext, args.fR, args.eR):
-        stats, bytes_, swept = bench(args.iters, args.quantities, ext, radius, args.inner, rt)
+        stats, bytes_, swept = bench(
+            args.iters, args.quantities, ext, radius, args.inner, rt, route
+        )
         if jax.process_index() == 0:
             print(report(name, bytes_, stats, swept))
+    result = {
+        "bench": "exchange",
+        "extent": list(ext),
+        "quantities": args.quantities,
+        "route_flag": args.route,
+        "host_round_trip_s": rt,
+    }
+    if args.ab_reps > 0:
+        ab_rt = rt if args.inner > 1 else 0.0
+        result["route_ab"] = route_ab(
+            ext, args.fR, args.quantities, args.ab_reps, ab_rt
+        )
+    if jax.process_index() == 0:
+        print(json.dumps(result))
     _common.telemetry_end(args)
     return 0
 

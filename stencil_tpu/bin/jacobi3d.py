@@ -63,6 +63,7 @@ def main(argv=None) -> int:
     )
     _common.add_telemetry_flags(p)
     _common.add_tune_flags(p)
+    _common.add_exchange_route_flag(p)
     p.add_argument("x", type=int, nargs="?", default=512)
     p.add_argument("y", type=int, nargs="?", default=512)
     p.add_argument("z", type=int, nargs="?", default=512)
@@ -135,6 +136,32 @@ def _run(args) -> int:
             )
         if report is not None:
             _common.tune_report_stderr(report)
+    elif args.tune and kernel_impl == "jnp":
+        # the jnp engine's macro step runs the GENERIC exchange — tune its
+        # z-sweep route (direct vs packed z-shell, docs/tuning.md "Exchange
+        # routes") so the model's realize picks the measured winner up.  The
+        # cache is checked BEFORE the probe domain realizes (tune_key works
+        # pre-realize), so a warm-cache --tune run does zero device work;
+        # the probe is freed before the model allocates.
+        from stencil_tpu import tune
+        from stencil_tpu.core.radius import Radius
+        from stencil_tpu.domain import DistributedDomain
+        from stencil_tpu.tune import runners as tune_runners
+
+        probe = DistributedDomain(x, y, z)
+        r = Radius.constant(0)
+        r.set_face(1)  # the jacobi radius (jacobi3d.cu:205-214)
+        probe.set_radius(r)
+        probe.set_placement(_common.parse_strategy(args))
+        probe.add_data("temp", dtype=jnp.dtype(args.dtype))
+        if args.halo_multiplier > 1:
+            probe.set_halo_multiplier(args.halo_multiplier)
+        if tune.best_config(probe.tune_key("exchange")) is not None:
+            print("tune[exchange]: source=cache (warm; zero trials)", file=sys.stderr)
+        else:
+            probe.realize()
+            _common.tune_report_stderr(tune_runners.autotune_exchange(probe))
+        del probe
     model = Jacobi3D(
         x,
         y,
@@ -149,6 +176,7 @@ def _run(args) -> int:
     )
     if args.halo_multiplier > 1:
         model.dd.set_halo_multiplier(args.halo_multiplier)
+    _common.apply_exchange_route(args, model.dd)
     model.realize()
     if args.plan:
         print(f"wrote {model.dd.write_plan(args.prefix + 'plan')}", file=sys.stderr)

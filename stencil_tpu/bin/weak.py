@@ -34,6 +34,7 @@ def run(x: int, y: int, z: int, n_iters: int, args, name: str = "weak") -> str:
     dd.set_methods(_common.parse_methods(args))
     dd.set_radius(Radius.constant(3))  # weak.cu:120
     dd.set_placement(_common.parse_strategy(args))
+    _common.apply_exchange_route(args, dd)
     for i in range(4):  # weak.cu:132-135
         dd.add_data(f"d{i}", dtype=jnp.float32)
     dd.enable_exchange_stats(True)
@@ -75,8 +76,11 @@ def build_parser(name: str) -> argparse.ArgumentParser:
     p.add_argument("--naive", action="store_true", help="trivial placement (weak.cu --naive)")
     p.add_argument("--cuda-aware", dest="cuda_aware_mpi", action="store_true")
     p.add_argument("--staged", action="store_true")
-    # no tune flags here: weak/strong drive the raw exchange (no planner
-    # ever consults the autotuner), so --tune would be a misleading no-op
+    # no tune flags here: weak/strong have no search of their own (--tune
+    # would be a misleading no-op) — but the exchange PLANNER does consult
+    # the tuned exchange-route config at realize() since the exchange-route
+    # PR; --exchange-route pins it per run
+    _common.add_exchange_route_flag(p)
     _common.add_telemetry_flags(p)
     return p
 

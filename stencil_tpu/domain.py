@@ -59,7 +59,7 @@ from stencil_tpu.parallel.placement import Placement
 from stencil_tpu import telemetry
 from stencil_tpu.telemetry import names as tm
 from stencil_tpu.utils.config import MethodFlags, PlacementStrategy
-from stencil_tpu.utils.logging import log_debug, log_info
+from stencil_tpu.utils.logging import log_debug, log_info, log_warn
 
 
 @dataclasses.dataclass(frozen=True)
@@ -177,6 +177,13 @@ class DistributedDomain:
         self._exchange_fn = None
         self._exchange_many_fn = None
         self._exchange_count = 0
+        # z-sweep exchange route (ops/exchange.py EXCHANGE_ROUTES): resolved
+        # at realize() — explicit request > STENCIL_EXCHANGE_ROUTE > tuned
+        # config > static "direct"; packed-route analytic accounting rides it
+        self._exchange_route_req: Optional[str] = None
+        self._exchange_route = "direct"
+        self._packed_nbytes = 0
+        self._packed_nkernels = 0
         self._halo_mult = 1
         self._shell_stale = False
         self._shell_radius: Optional[Radius] = None
@@ -249,6 +256,32 @@ class DistributedDomain:
     def halo_multiplier(self) -> int:
         return self._halo_mult
 
+    def set_exchange_route(self, route: Optional[str]) -> None:
+        """Pin the z-sweep exchange route (ops/exchange.py
+        ``EXCHANGE_ROUTES``: ``direct`` | ``zpack_xla`` | ``zpack_pallas``).
+        ``None``/"auto" restores planner resolution: ``STENCIL_EXCHANGE_ROUTE``,
+        then the tuned config (``tune.best_config`` on this domain's
+        "exchange" workload key), then the static ``direct`` fallback.  An
+        explicit pin — like every explicit request — never consults the
+        tuner; it still steps down to ``direct`` if the packed kernels are
+        rejected at compile (the resilience ladder) or structurally cannot
+        engage (uneven z split, unsupported dtype)."""
+        from stencil_tpu.ops.exchange import EXCHANGE_ROUTES
+
+        if route in (None, "auto"):
+            self._exchange_route_req = None
+            return
+        if route not in EXCHANGE_ROUTES:
+            raise ValueError(
+                f"unknown exchange route {route!r} (one of {EXCHANGE_ROUTES})"
+            )
+        assert not self._realized, "set_exchange_route must precede realize()"
+        self._exchange_route_req = route
+
+    def exchange_route(self) -> str:
+        """The resolved z-sweep route (meaningful after ``realize()``)."""
+        return self._exchange_route
+
     def tune_key(self, route: str):
         """The autotuner ``WorkloadKey`` for this domain under ``route`` —
         THE one place the (chip kind, domain shape, dtype, n_fields, mesh
@@ -273,6 +306,13 @@ class DistributedDomain:
         rmax = max(
             r.lo().x, r.lo().y, r.lo().z, r.hi().x, r.hi().y, r.hi().z
         )
+        if route == "exchange":
+            # the exchange operates on the SHELL (user radius × halo
+            # multiplier): its z message depth is what a route winner was
+            # measured at, so the multiplier must re-key the workload.  The
+            # temporally-blocked routes key by the user radius instead —
+            # there the multiplier IS the tuned axis, not a key axis.
+            rmax *= max(self._halo_mult, 1)
         dtypes = ",".join(sorted({h.dtype.name for h in self._handles}))
         return WorkloadKey(
             chip=chip_kind(),
@@ -374,25 +414,152 @@ class DistributedDomain:
                 else make_exchange_fn_rollcompare
             )
             self._exchange_fn = maker(self.mesh, r, self._spec, dim)
+            self._exchange_route = "direct"  # the debug oracles have no z route
+            self.stats.time_plan = time.perf_counter() - t0
+            # eager trace+compile of the exchange — the analog of the
+            # reference's sender/recver creation + CUDA-Graph capture
+            # (src/stencil.cu:385-529); later exchange() calls hit the
+            # executable cache.
+            if self._handles:
+                t0 = time.perf_counter()
+                self._exchange_fn.lower(self._curr).compile()
+                self._record_exchange_compile(t0, "realize")
         else:
-            self._exchange_fn = make_exchange_fn(self.mesh, r, valid_last=self._valid_last)
-        self.stats.time_plan = time.perf_counter() - t0
-        # eager trace+compile of the exchange — the analog of the reference's
-        # sender/recver creation + CUDA-Graph capture (src/stencil.cu:385-529);
-        # later exchange() calls hit the executable cache.
-        if self._handles:
+            self._exchange_route = self._resolve_exchange_route()
+            self.stats.time_plan = time.perf_counter() - t0
+            # build + eager-compile through the route ladder: a packed route
+            # the compiler rejects (VMEM_OOM / COMPILE_REJECT) steps down to
+            # `direct`; the compile itself rides the transient-retry policy
+            # (remote-compile tunnel drops — the BENCH_r05 class — retry
+            # instead of killing realize)
             t0 = time.perf_counter()
-            self._exchange_fn.lower(self._curr).compile()
-            self.stats.time_create = time.perf_counter() - t0
-            telemetry.observe(tm.COMPILE_SECONDS, self.stats.time_create)
-            telemetry.emit_event(
-                tm.EVENT_COMPILE,
-                phase="exchange",
-                label="realize",
-                seconds=round(self.stats.time_create, 6),
-            )
+            self._exchange_fn = self._build_exchange_with_ladder()
+            if self._handles:
+                self._record_exchange_compile(t0, f"realize:{self._exchange_route}")
         self._realized = True
         log_info(f"realized {self._size} over mesh {dim} (raw shard {raw})")
+
+    def _record_exchange_compile(self, t0: float, label: str) -> None:
+        self.stats.time_create = time.perf_counter() - t0
+        telemetry.observe(tm.COMPILE_SECONDS, self.stats.time_create)
+        telemetry.emit_event(
+            tm.EVENT_COMPILE,
+            phase="exchange",
+            label=label,
+            seconds=round(self.stats.time_create, 6),
+        )
+
+    def _resolve_exchange_route(self) -> str:
+        """Resolve the z-sweep exchange route for this realize.  Precedence
+        (mirrors the stream-alias rule): explicit ``set_exchange_route`` >
+        ``STENCIL_EXCHANGE_ROUTE`` (validated read) > the tuned config
+        (``tune.best_config`` on the "exchange" workload key) > the static
+        ``direct`` fallback (ROADMAP: calibration constants are fallbacks).
+        A route the pack pipeline structurally cannot serve (uneven z split,
+        unsupported dtype) degrades to ``direct`` with a warning — a stale
+        or wrong persisted config must never crash a run the fallback could
+        have served.  Every resolution is an ``exchange.route`` telemetry
+        decision event."""
+        from stencil_tpu.ops.exchange import EXCHANGE_ROUTES, zpack_supported
+        from stencil_tpu.utils.config import env_choice
+
+        route: Optional[str] = None
+        source = "static"
+        if self._exchange_route_req is not None:
+            route, source = self._exchange_route_req, "explicit"
+        else:
+            env = env_choice(
+                "STENCIL_EXCHANGE_ROUTE", "auto", ("auto",) + EXCHANGE_ROUTES
+            )
+            if env != "auto":
+                route, source = env, "env"
+        if route is None:
+            from stencil_tpu import tune
+
+            cfg = tune.best_config(self.tune_key("exchange"))
+            tuned = (cfg or {}).get("exchange_route")
+            if tuned is not None:
+                if tuned in EXCHANGE_ROUTES:
+                    route, source = str(tuned), "tuned"
+                else:
+                    log_warn(
+                        f"tuned exchange_route {tuned!r} is not one of "
+                        f"{EXCHANGE_ROUTES}; using the static 'direct' fallback"
+                    )
+        if route is None:
+            route = "direct"
+        if route != "direct" and not zpack_supported(
+            [h.dtype for h in self._handles], self._valid_last
+        ):
+            log_warn(
+                f"exchange route {route!r} ({source}) cannot engage here "
+                "(uneven z split or unsupported dtype); degrading to 'direct'"
+            )
+            route, source = "direct", source + "/degraded"
+        telemetry.emit_event(tm.EVENT_EXCHANGE_ROUTE, route=route, source=source)
+        return route
+
+    def make_exchange_route_fn(
+        self,
+        route: str,
+        donate: bool = True,
+        axes: Tuple[int, ...] = (0, 1, 2),
+    ):
+        """One jitted exchange over this domain's quantities for ``route``,
+        eagerly compiled (compile rides the transient-retry policy, so
+        remote-compile tunnel drops retry instead of dying).  The production
+        path uses it at realize; the autotuner's route trials and
+        bench-exchange's A/B build non-donating (``donate=False``) variants
+        so measuring never consumes the live buffers."""
+        from stencil_tpu.resilience import inject
+        from stencil_tpu.resilience.retry import execute_with_retry
+
+        fn = make_exchange_fn(
+            self.mesh,
+            self._shell_radius,
+            valid_last=self._valid_last,
+            route=route,
+            axes=axes,
+            donate=donate,
+        )
+        if self._handles:
+            label = f"compile:exchange:{route}"
+
+            def compile_unit():
+                # the fault hook sits INSIDE the retried unit (the run_step
+                # dispatch() pattern) so injected tunnel drops exercise the
+                # same retry path the real remote-compile failures take
+                inject.maybe_fail("compile", label)
+                return fn.lower(self._curr).compile()
+
+            execute_with_retry(compile_unit, label=label)
+        return fn
+
+    def _build_exchange_with_ladder(self):
+        """Build (and compile) the production exchange for the resolved
+        route.  Packed routes ride a two-rung degradation ladder: a VMEM_OOM
+        or COMPILE_REJECT building the packed exchange descends to
+        ``direct`` (counted + event-logged by the ladder) instead of failing
+        realize."""
+        route = self._exchange_route
+        if route == "direct":
+            return self.make_exchange_route_fn("direct")
+        from stencil_tpu.resilience.ladder import DegradationLadder, Rung
+
+        def rung_for(rt: str) -> Rung:
+            return Rung(rt, build=lambda rt=rt: self.make_exchange_route_fn(rt))
+
+        def lower(rung, cls, exc):
+            return rung_for("direct") if rung.name != "direct" else None
+
+        ladder = DegradationLadder(rung_for(route), lower, label="exchange")
+        fn = ladder.built()
+        if ladder.rung.name != route:
+            self._exchange_route = ladder.rung.name
+            telemetry.emit_event(
+                tm.EVENT_EXCHANGE_ROUTE, route=ladder.rung.name, source="ladder"
+            )
+        return fn
 
     def abstract_arrays(self) -> Dict[str, jax.ShapeDtypeStruct]:
         """Sharded ShapeDtypeStructs matching the quantity arrays — lowering
@@ -649,8 +816,31 @@ class DistributedDomain:
             telemetry.set_gauge(
                 tm.EXCHANGE_BYTES_PER_EXCHANGE, self._exchange_nbytes
             )
+            if self._handles and self._exchange_route != "direct":
+                # analytic packed-route traffic (like the bytes model above:
+                # modeled once, an int multiply on the hot path)
+                from stencil_tpu.ops.exchange import zpack_message_stats
+
+                raw = self._spec.raw_size()
+                shell = self._shell_radius
+                itemsizes = [
+                    h.dtype.itemsize
+                    for h in self._handles
+                    for _ in range(h.cell_count())
+                ]
+                nbytes, kernels = zpack_message_stats(
+                    (raw.x, raw.y, raw.z),
+                    shell.axis(2, -1),
+                    shell.axis(2, +1),
+                    itemsizes,
+                )
+                self._packed_nbytes = nbytes * self.num_subdomains()
+                self._packed_nkernels = kernels * self.num_subdomains()
         telemetry.inc(tm.EXCHANGE_COUNT, n)
         telemetry.inc(tm.EXCHANGE_BYTES, n * self._exchange_nbytes)
+        if self._packed_nkernels:
+            telemetry.inc(tm.EXCHANGE_PACKED_BYTES, n * self._packed_nbytes)
+            telemetry.inc(tm.EXCHANGE_PACKED_KERNELS, n * self._packed_nkernels)
 
     def exchange(self) -> None:
         """Fill every quantity's halo shell (src/stencil.cu:670-864)."""
@@ -912,7 +1102,9 @@ class DistributedDomain:
                     int_region = rect_to_slices(interior_rect)
                     int_vals = region_update(blocks, int_region, origin)
             # joint multi-quantity exchange: all fields fuse into one message
-            # per direction (reference packer.cuh:52-69), ≤6 permutes total
+            # per direction (reference packer.cuh:52-69), ≤6 permutes total;
+            # the z sweep runs the realize-resolved route, so fused steps
+            # escape the 64×-amplified thin-z path exactly like exchange()
             exch = dict(
                 zip(
                     names,
@@ -921,6 +1113,7 @@ class DistributedDomain:
                         shell,
                         mesh_shape,
                         valid_last=self._valid_last,
+                        route=self._exchange_route,
                     ),
                 )
             )
@@ -958,15 +1151,16 @@ class DistributedDomain:
 
         specs = tuple(_qspec(h) for h in self._handles)
         donate_kw = {"donate_argnums": 0} if donate else {}
-        # vma validation stays on whenever the exchange's blend kernels can't
-        # engage — user kernels get full varying-manual-axes checking on the
-        # plain-DUS path
-        from stencil_tpu.ops import halo_blend
+        # vma validation stays on whenever neither the exchange's blend
+        # kernels nor the packed pallas route can engage — user kernels get
+        # full varying-manual-axes checking on the plain-DUS path
+        from stencil_tpu.ops.exchange import route_vma_check
 
-        check_vma = halo_blend.vma_check(
+        check_vma = route_vma_check(
             [h.dtype for h in self._handles],
             self._valid_last,
             max((len(h.components) for h in self._handles), default=0),
+            self._exchange_route,
         )
 
         @partial(jax.jit, static_argnums=1, **donate_kw)

@@ -22,6 +22,14 @@ and the slab sent in ``-a`` has width ``radius(+a)`` (packer.cuh:91-93).
 A mesh axis of size 1 still ppermutes to itself — that self-wrap implements
 periodic boundaries within one shard, the collapse of the reference's
 same-GPU ``PeerAccessSender`` kernels (tx_cuda.cuh:39-104).
+
+The z sweep has selectable ROUTES (``EXCHANGE_ROUTES``, a tuner axis —
+docs/tuning.md "Exchange routes"): ``direct`` sends the thin-z sliver slab
+as sliced (the historical path, ~64×-amplified on the (8,128) tiling —
+PERF_NOTES "Thin z-region access"), the ``zpack_*`` routes send the shell
+lane-major through the pack pipeline (``_zpack_sweep`` / ops/pack.py), the
+reference packer's move (packer.cuh:71-366): reshape the message, not the
+domain.  All routes produce bitwise-identical halos.
 """
 
 from __future__ import annotations
@@ -38,6 +46,63 @@ from stencil_tpu.core.dim3 import Dim3
 from stencil_tpu.utils.compat import shard_map
 from stencil_tpu.core.radius import Radius
 from stencil_tpu.parallel.mesh import MESH_AXES
+
+#: exchange implementations for the z axis sweep — a first-class tuner axis
+#: (tune/space.py ``exchange_space``; docs/tuning.md "Exchange routes"):
+#:
+#: * ``direct``       — send the (X, Y, r) z-sliver slab as sliced (the
+#:   historical path; the static no-tune fallback).  On the (8,128)-tiled
+#:   layout that sliver is ~64×-amplified (PERF_NOTES "Thin z-region
+#:   access"): a radius-2 z exchange costs ~one full-domain copy at 512³.
+#: * ``zpack_xla``    — reshape the message, not the domain: the shell
+#:   travels lane-major as ``(2m, Y, Xpad)`` (ops/pack.py ``pack_zshell_*``)
+#:   with XLA fusing the slice+transpose into the permute operand.
+#: * ``zpack_pallas`` — same buffer, but packed/unpacked by the tile-local
+#:   pallas pipeline (whole x-planes HBM->VMEM, the thin cut in VMEM) so the
+#:   big array is never read or written through a thin-z window at all.
+EXCHANGE_ROUTES = ("direct", "zpack_xla", "zpack_pallas")
+
+
+def zpack_supported(dtypes, valid_last=None) -> bool:
+    """Can the packed z routes engage for this configuration?  Requires an
+    evenly divided z axis (the pack kernels cut the shell at static offsets;
+    a padded z falls back to ``direct`` for that sweep) and dtypes whose
+    (8,128) tile geometry the kernels know (``halo_blend.supports``)."""
+    from stencil_tpu.ops import halo_blend
+
+    if valid_last is not None and valid_last[2] is not None:
+        return False
+    return all(halo_blend.supports(dt) for dt in dtypes)
+
+
+def route_vma_check(dtypes, valid_last, ndim_extra: int, route: str) -> bool:
+    """``check_vma`` for a shard_map wrapping the exchange, route-aware: the
+    packed pallas route's outputs carry no vma annotation (exactly like the
+    blend kernels), so validation must stay off whenever it can engage."""
+    from stencil_tpu.ops import halo_blend
+
+    if route == "zpack_pallas" and zpack_supported(dtypes, valid_last):
+        return False
+    return halo_blend.vma_check(dtypes, valid_last, ndim_extra)
+
+
+def zpack_message_stats(raw_spatial, r_lo: int, r_hi: int, itemsizes) -> Tuple[int, int]:
+    """Analytic (bytes, kernels) per shard per exchange through a packed z
+    route: one ``(depth, Y, Xpad)`` buffer per 3D quantity slice per
+    direction, one pack + one unpack kernel each (the ``exchange.packed.*``
+    telemetry counters — modeled, like ``exchange_bytes_total``)."""
+    from stencil_tpu.ops.pack import lane_pad
+
+    X, Y, _ = raw_spatial
+    nbytes = 0
+    kernels = 0
+    for depth in (r_lo, r_hi):
+        if depth == 0:
+            continue
+        for isz in itemsizes:
+            nbytes += depth * Y * lane_pad(X) * isz
+            kernels += 2  # pack + unpack
+    return nbytes, kernels
 
 
 def _shift_from_low(x, axis_name: str, n: int):
@@ -114,6 +179,94 @@ def _fused_shift(slabs: List[jax.Array], shift_fn, name: str, n_dev: int) -> Lis
     return out  # type: ignore[return-value]
 
 
+def _zpack_sweep(
+    blocks: List[jax.Array],
+    r_lo: int,
+    r_hi: int,
+    n_pad: int,
+    name: str,
+    n_dev: int,
+    route: str,
+) -> List[jax.Array]:
+    """One z-axis sweep through the packed pipeline (the tentpole of the
+    exchange-route PR): extract every quantity's 2m-deep shell into
+    lane-major ``(2m, Y, Xpad)`` buffers (``ops/pack.py``), ppermute the
+    buffers as ONE fused message per direction (the ≤6-permute structure is
+    preserved — this replaces the direct sweep's permutes one-for-one), and
+    blend them back through aliased tile-local kernels.  On the
+    ``zpack_pallas`` route the big array is only ever touched as whole
+    x-planes — the ~64×-amplified thin-z access and the ``sliver-dus``
+    relayout trap are impossible by construction (PERF_NOTES "Thin z-region
+    access").  ``zpack_xla`` sends the same buffer but lets XLA fuse the
+    packing; the received shell re-materializes as a thin slab only outside
+    the big array, then lands via the blend kernels.
+
+    Leading component/batch dims are flattened into per-slice 3D packs;
+    all slices of all quantities still fuse into one message per direction.
+    """
+    from stencil_tpu.ops import halo_blend
+    from stencil_tpu.ops.pack import (
+        pack_zshell_pallas,
+        pack_zshell_xla,
+        unpack_zshell_pallas,
+        zshell_to_slab,
+    )
+
+    interp = halo_blend.interpret_mode()
+    pallas = route == "zpack_pallas"
+    # each 3D slice of each quantity packs its own buffer; the per-direction
+    # message stays ONE fused ppermute regardless (packer.cuh:52-69)
+    flat = [b.reshape((-1,) + b.shape[-3:]) for b in blocks]
+
+    def pack_all(z0: int, depth: int) -> List[jax.Array]:
+        return [
+            pack_zshell_pallas(f[j], z0, depth, interpret=interp)
+            if pallas
+            else pack_zshell_xla(f[j], z0, depth)
+            for f in flat
+            for j in range(f.shape[0])
+        ]
+
+    lo_bufs = hi_bufs = None
+    if r_lo > 0:
+        # my low halo [z=0, r_lo) <- -z neighbor's top interior slab
+        lo_bufs = _fused_shift(pack_all(n_pad, r_lo), _shift_from_low, name, n_dev)
+    if r_hi > 0:
+        hi_bufs = _fused_shift(pack_all(r_lo, r_hi), _shift_from_high, name, n_dev)
+    blend = halo_blend.enabled()
+    out_blocks: List[jax.Array] = []
+    idx = 0  # slice cursor — pack_all emits both directions in this order
+    for b, f in zip(blocks, flat):
+        outs = []
+        for j in range(f.shape[0]):
+            s = f[j]
+            x = s.shape[0]
+            if lo_bufs is not None:
+                if pallas:
+                    s = unpack_zshell_pallas(s, lo_bufs[idx], 0, r_lo, interpret=interp)
+                elif blend:
+                    s = halo_blend.blend_slab(
+                        s, zshell_to_slab(lo_bufs[idx], x), 2, 0, interpret=interp
+                    )
+                else:
+                    s = s.at[:, :, 0:r_lo].set(zshell_to_slab(lo_bufs[idx], x))
+            if hi_bufs is not None:
+                z0 = r_lo + n_pad
+                if pallas:
+                    s = unpack_zshell_pallas(s, hi_bufs[idx], z0, r_hi, interpret=interp)
+                elif blend:
+                    s = halo_blend.blend_slab(
+                        s, zshell_to_slab(hi_bufs[idx], x), 2, z0, interpret=interp
+                    )
+                else:
+                    s = s.at[:, :, z0 : z0 + r_hi].set(zshell_to_slab(hi_bufs[idx], x))
+            outs.append(s)
+            idx += 1
+        out = outs[0] if len(outs) == 1 else jnp.concatenate([o[None] for o in outs])
+        out_blocks.append(out.reshape(b.shape))
+    return out_blocks
+
+
 def halo_exchange_multi(
     blocks: Sequence[jax.Array],
     radius: Radius,
@@ -121,6 +274,7 @@ def halo_exchange_multi(
     axis_names: Sequence[str] = MESH_AXES,
     valid_last: Optional[Tuple[Optional[int], Optional[int], Optional[int]]] = None,
     axes: Tuple[int, ...] = (0, 1, 2),
+    route: str = "direct",
 ) -> List[jax.Array]:
     """Fill the halo shells of several shell-carrying shards JOINTLY —
     ≤ 2 ppermutes per axis sweep (≤ 6 total) no matter how many quantities,
@@ -139,7 +293,16 @@ def halo_exchange_multi(
     of its own valid cells and writes the received +axis halo right after its
     valid cells — slab positions become per-shard ``lax.dynamic_slice``
     offsets derived from ``axis_index``; the collective itself is unchanged.
+
+    ``route`` picks the z-sweep implementation (``EXCHANGE_ROUTES``):
+    ``direct`` is today's sliced-slab path; the ``zpack_*`` routes send the
+    z shell through the lane-major pack pipeline (``_zpack_sweep``) —
+    bitwise-identical halos, a differently shaped message.  A packed route
+    that cannot engage (uneven z, unsupported dtype) silently runs that
+    sweep ``direct``, so a pinned route is always correct.
     """
+    if route not in EXCHANGE_ROUTES:
+        raise ValueError(f"unknown exchange route {route!r} (one of {EXCHANGE_ROUTES})")
     blocks = list(blocks)
     if not blocks:
         return blocks
@@ -160,6 +323,13 @@ def halo_exchange_multi(
         n_pad = size - r_lo - r_hi  # per-shard (padded) interior width
         v_last = valid_last[axis] if valid_last is not None else None
         uneven = v_last is not None and v_last != n_pad
+
+        if route != "direct" and axis == 2 and not uneven:
+            from stencil_tpu.ops import halo_blend
+
+            if all(halo_blend.supports(b.dtype) for b in blocks):
+                blocks = _zpack_sweep(blocks, r_lo, r_hi, n_pad, name, n_dev, route)
+                continue
 
         def axslice(b, lo, hi):
             idx = [slice(None)] * b.ndim
@@ -259,10 +429,11 @@ def halo_exchange_shard(
     axis_names: Sequence[str] = MESH_AXES,
     valid_last: Optional[Tuple[Optional[int], Optional[int], Optional[int]]] = None,
     axes: Tuple[int, ...] = (0, 1, 2),
+    route: str = "direct",
 ) -> jax.Array:
     """Single-quantity convenience wrapper over ``halo_exchange_multi``."""
     return halo_exchange_multi(
-        [block], radius, mesh_shape, axis_names, valid_last, axes=axes
+        [block], radius, mesh_shape, axis_names, valid_last, axes=axes, route=route
     )[0]
 
 
@@ -355,6 +526,9 @@ def make_exchange_fn(
     radius: Radius,
     ndim_extra: int = 0,
     valid_last: Optional[Tuple[Optional[int], Optional[int], Optional[int]]] = None,
+    route: str = "direct",
+    axes: Tuple[int, ...] = (0, 1, 2),
+    donate: bool = True,
 ):
     """Build a jitted exchange over a pytree of shell-carrying global arrays.
 
@@ -362,29 +536,43 @@ def make_exchange_fn(
     ``P('x','y','z')`` on its last three dims; leading component/batch dims
     (N-D data, per leaf — ``leaf.ndim - 3``; ``ndim_extra`` sets a floor for
     validation bookkeeping) are unsharded and ride inside the fused
-    per-direction messages.  Donates its input: the halo write is in-place
-    in HBM, like the reference filling halos inside the existing
-    allocation.  ``valid_last`` — see ``halo_exchange_shard``.
+    per-direction messages.  Donates its input (``donate=False`` for
+    measurement harnesses that must not consume the domain's live buffers —
+    the autotuner's route trials, bench-exchange's A/B): the halo write is
+    in-place in HBM, like the reference filling halos inside the existing
+    allocation.  ``valid_last`` — see ``halo_exchange_shard``; ``route`` —
+    see ``EXCHANGE_ROUTES``; ``axes`` restricts the sweeps (bench-exchange's
+    per-axis breakdown).
     """
+    if route not in EXCHANGE_ROUTES:
+        raise ValueError(f"unknown exchange route {route!r} (one of {EXCHANGE_ROUTES})")
     mesh_shape = tuple(mesh.shape[a] for a in MESH_AXES)
 
     def leaf_spec(leaf) -> P:
         assert leaf.ndim >= 3, leaf.shape
         return P(*([None] * (leaf.ndim - 3)), *MESH_AXES)
 
-    @partial(jax.jit, donate_argnums=0)
+    donate_kw = {"donate_argnums": 0} if donate else {}
+
+    @partial(jax.jit, **donate_kw)
     def exchange(arrays):
         def per_shard(*blocks):
             # ALL quantities (and any leading batch dims) ride one fused
             # message per direction — ≤6 permutes total (packer.cuh:52-69)
             return tuple(
-                halo_exchange_multi(blocks, radius, mesh_shape, valid_last=valid_last)
+                halo_exchange_multi(
+                    blocks,
+                    radius,
+                    mesh_shape,
+                    valid_last=valid_last,
+                    axes=axes,
+                    route=route,
+                )
             )
 
         leaves, treedef = jax.tree.flatten(arrays)
-        # vma validation stays on whenever the blend kernels can't engage
-        from stencil_tpu.ops import halo_blend
-
+        # vma validation stays on whenever neither the blend kernels nor the
+        # packed pallas route can engage
         max_extra = max(
             [ndim_extra] + [l.ndim - 3 for l in leaves], default=ndim_extra
         )
@@ -393,8 +581,8 @@ def make_exchange_fn(
             mesh=mesh,
             in_specs=tuple(leaf_spec(l) for l in leaves),
             out_specs=tuple(leaf_spec(l) for l in leaves),
-            check_vma=halo_blend.vma_check(
-                [l.dtype for l in leaves], valid_last, max_extra
+            check_vma=route_vma_check(
+                [l.dtype for l in leaves], valid_last, max_extra, route
             ),
         )
         return jax.tree.unflatten(treedef, list(shard_fn(*leaves)))

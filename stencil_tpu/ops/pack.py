@@ -9,12 +9,20 @@ aligned to the element size and the ``halo_extent(-dir)`` region is appended
 (packer.cuh:146-160) — the ``-dir`` convention: the *receiver's* halo width
 rules the message size (packer.cuh:91-93).
 
-TPU design: the production exchange (ops/exchange.py) sends slabs directly —
-XLA fuses the slicing into the ppermute, playing the role of the pack kernel.
-This module exists for (a) parity of the buffer-layout math (``PackPlan``,
-byte-exact with the reference incl. the 264-byte multi-dtype case,
-test_cuda_packer.cu:74-92), (b) packed-exchange experiments (fewer, larger
-messages), and (c) the ``bench-pack`` kernel benchmark.  Two backends:
+TPU design: the production exchange (ops/exchange.py) has two message
+shapes.  The ``direct`` route sends slabs as sliced — XLA fuses the slicing
+into the ppermute, playing the role of the pack kernel.  The packed z-shell
+route (``zpack_xla`` / ``zpack_pallas``, a tuner axis since the
+exchange-route PR) sends the z shell through THIS module's
+``pack_zshell_*`` / ``unpack_zshell_*`` pipeline instead: on the
+(8,128)-tiled layout a thin-z sliver read/write is ~64×-amplified
+(PERF_NOTES "Thin z-region access"), so the shell leaves HBM as whole
+x-plane DMAs, is cut and transposed in VMEM, and travels lane-major as
+``(2m, Y, Xpad)`` — the big array is never touched through a thin-z window.
+This module also holds (a) parity of the reference's buffer-layout math
+(``PackPlan``, byte-exact with the reference incl. the 264-byte multi-dtype
+case, test_cuda_packer.cu:74-92) and (b) the ``bench-pack`` kernel
+benchmark.  Two backends:
 
 * ``xla`` — gather/scatter via slice + bitcast + concat; XLA fuses this into
   a handful of copies (the analog of the reference's CUDA-Graph replay being
@@ -255,3 +263,104 @@ def make_unpack_fn_pallas(spec: LocalSpec, directions: Sequence, dtype, interpre
         return block
 
     return unpack, plan
+
+
+# --- Production z-shell pack route -------------------------------------------
+#
+# The exchange's packed z route (ops/exchange.py ``zpack_*``): the z shell of
+# a (X, Y, Z) shard travels as a lane-major ``(depth, Y, Xpad)`` buffer.
+# Rationale (PERF_NOTES "Thin z-region access" / "Block SHAPE orientation"):
+# a (X, Y, depth) z-sliver has ``depth`` lanes — lane-padded to 128, every
+# read/write of it through the big array costs a whole tile-column pass
+# (~64× amplification at depth 2).  z-major, the lane dim is X (whole, well
+# shaped, padded up to a 128 multiple with dead columns the unpack never
+# reads), and the thin ``depth`` extent sublane-pads to at most 8.
+
+
+def lane_pad(n: int) -> int:
+    """Round a lane extent up to the (8,128) tiling's 128-lane multiple."""
+    return next_align_of(n, 128)
+
+
+def zshell_buffer_shape(block_shape, depth: int):
+    """Shape of one z-shell message buffer for a ``(X, Y, Z)`` block."""
+    X, Y = block_shape[0], block_shape[1]
+    return (depth, Y, lane_pad(X))
+
+
+def pack_zshell_xla(block: jax.Array, z0: int, depth: int) -> jax.Array:
+    """``block[:, :, z0:z0+depth]`` as the lane-major ``(depth, Y, Xpad)``
+    message buffer, via plain XLA (slice + transpose + lane pad).  XLA is
+    free to fuse the reshaping into the ppermute operand — a measurably
+    different message shape from ``direct``, hence its own tuner candidate."""
+    X = block.shape[0]
+    buf = jnp.transpose(block[:, :, z0 : z0 + depth], (2, 1, 0))
+    pad = lane_pad(X) - X
+    if pad:
+        buf = jnp.pad(buf, ((0, 0), (0, 0), (0, pad)))
+    return buf
+
+
+def zshell_to_slab(buf: jax.Array, x: int) -> jax.Array:
+    """Inverse of the pack transpose: the received ``(depth, Y, Xpad)``
+    buffer as an ``(x, Y, depth)`` slab (dead pad columns dropped) — the
+    shape the exchange's existing halo-write path (blend kernel or set)
+    consumes.  Only the small message buffer is read thin-z here, never the
+    big array."""
+    return jnp.transpose(buf[:, :, :x], (2, 1, 0))
+
+
+def pack_zshell_pallas(
+    block: jax.Array, z0: int, depth: int, interpret: bool = False
+) -> jax.Array:
+    """Pallas z-shell pack: grid-stream whole x-planes HBM -> VMEM (lane-
+    tile-aligned movement), cut the ``[z0, z0+depth)`` window and transpose
+    it z-major on the VPU (small (Y, depth) <-> (depth, Y) in-kernel
+    transposes are supported — PERF_NOTES "Mosaic limits"), land each
+    plane's column in the ``(depth, Y, Xpad)`` buffer.  Pad columns past X
+    are never visited (their contents are dead; the unpack never reads
+    them)."""
+    from jax.experimental import pallas as pl
+
+    X, Y, Z = block.shape
+
+    def kernel(src_ref, out_ref):
+        out_ref[:, :, 0] = src_ref[0, :, z0 : z0 + depth].T
+
+    return pl.pallas_call(
+        kernel,
+        grid=(X,),
+        in_specs=[pl.BlockSpec((1, Y, Z), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((depth, Y, 1), lambda i: (0, 0, i)),
+        out_shape=jax.ShapeDtypeStruct(zshell_buffer_shape(block.shape, depth), block.dtype),
+        interpret=interpret,
+    )(block)
+
+
+def unpack_zshell_pallas(
+    block: jax.Array, buf: jax.Array, z0: int, depth: int, interpret: bool = False
+) -> jax.Array:
+    """Blend a received ``(depth, Y, Xpad)`` z-shell buffer into
+    ``block[:, :, z0:z0+depth]`` — aliased read-modify-write of whole
+    x-planes (``input_output_aliases``), the transpose back happening in
+    VMEM.  The big array is written plane-at-a-time in its native tiled
+    layout; the thin-z patch exists only inside VMEM, so the ``sliver-dus``
+    relayout trap is impossible by construction."""
+    from jax.experimental import pallas as pl
+
+    X, Y, Z = block.shape
+
+    def kernel(blk_ref, buf_ref, out_ref):
+        out_ref[0] = blk_ref[0]
+        out_ref[0, :, z0 : z0 + depth] = buf_ref[:, :, 0].T
+
+    plane = pl.BlockSpec((1, Y, Z), lambda i: (i, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(X,),
+        in_specs=[plane, pl.BlockSpec((depth, Y, 1), lambda i: (0, 0, i))],
+        out_specs=plane,
+        out_shape=jax.ShapeDtypeStruct(block.shape, block.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(block, buf)

@@ -773,6 +773,10 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
         groups = [[q] for q in range(len(names))]
     else:
         groups = [list(range(len(names)))]
+    # the z sweep of every in-step exchange runs the domain's realize-
+    # resolved route (packed z-shell vs direct — ops/exchange.py), so stream
+    # steps escape the 64×-amplified thin-z path exactly like exchange()
+    exch_route = getattr(dd, "_exchange_route", "direct")
     # Un-aliased wavefront passes are ~10-20% faster for FEW fields
     # (probe21b: the in-place alias serializes the deep-m pipeline) but cost
     # one fresh raw-sized buffer per pass.  From 4 fields up, alias: a joint
@@ -830,7 +834,10 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
             def body(_, bs):
                 origin = origin_of()
                 bs = list(
-                    halo_exchange_multi(bs, shell, mesh_shape, valid_last=valid_last)
+                    halo_exchange_multi(
+                        bs, shell, mesh_shape, valid_last=valid_last,
+                        route=exch_route,
+                    )
                 )
                 out = list(bs)
                 for g in groups:
@@ -878,7 +885,8 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
                     origin = origin_of()
                     bs = list(
                         halo_exchange_multi(
-                            bs, shell, mesh_shape, valid_last=valid_last
+                            bs, shell, mesh_shape, valid_last=valid_last,
+                            route=exch_route,
                         )
                     )
                     outs, _ = wavefront_groups(bs, depth, origin)

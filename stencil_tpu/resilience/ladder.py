@@ -116,6 +116,14 @@ class DegradationLadder:
             )
         return self._impl
 
+    def built(self) -> Callable:
+        """The current rung's built impl (building it if needed) — for call
+        sites that use the ladder for classified BUILD-time descent only and
+        then drive the impl directly (e.g. ``DistributedDomain.realize``'s
+        exchange-route step-down, where the per-call path must stay a bare
+        function call)."""
+        return self._ensure_built()
+
     def _descend(self, cls: FailureClass, exc: BaseException) -> bool:
         """Install the next rung down; False when the ladder is exhausted."""
         if self._lower is None:

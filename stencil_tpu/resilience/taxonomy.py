@@ -93,6 +93,11 @@ _TRANSIENT_MARKERS = (
     "tunnel",
     "temporarily unavailable",
     "try again later",
+    # the axon remote-compile body drop that produced BENCH_r05.json's rc=1:
+    # "INTERNAL: http://127.0.0.1:8113/remote_compile: read body: response
+    # body closed before all bytes were read" (a JaxRuntimeError at
+    # realize()'s eager exchange compile) — a dropped HTTP stream, retryable
+    "response body closed",
 )
 
 #: Non-VMEM Mosaic/XLA capability rejections observed by this repo's probes

@@ -52,10 +52,18 @@ TUNE_TRIALS = "tune.trials"
 TUNE_PRUNED = "tune.pruned"
 #: winning configs selected (and persisted) by a completed search
 TUNE_SELECTED = "tune.selected"
+#: analytic bytes moved through packed z-shell message buffers (the
+#: ``zpack_*`` exchange routes; 0 under ``direct`` — ops/exchange.py
+#: ``zpack_message_stats``)
+EXCHANGE_PACKED_BYTES = "exchange.packed.bytes"
+#: analytic pack+unpack kernel launches of those packed exchanges
+EXCHANGE_PACKED_KERNELS = "exchange.packed.kernels"
 
 ALL_COUNTERS = frozenset({
     EXCHANGE_COUNT,
     EXCHANGE_BYTES,
+    EXCHANGE_PACKED_BYTES,
+    EXCHANGE_PACKED_KERNELS,
     STEP_DISPATCHES,
     STEP_ITERATIONS,
     RETRY_ATTEMPTS,
@@ -132,6 +140,10 @@ EVENT_TUNE_DECISION = "tune.decision"
 #: one autotuner trial finished (fields: key, candidate, seconds_per_iter —
 #: or failure_class/error when the candidate was pruned)
 EVENT_TUNE_TRIAL = "tune.trial"
+#: the exchange planner resolved its z-sweep route (fields: route,
+#: source=explicit|env|tuned|static|ladder — or "<orig>/degraded" when a
+#: packed pick structurally could not engage)
+EVENT_EXCHANGE_ROUTE = "exchange.route"
 
 ALL_EVENTS = frozenset({
     EVENT_COMPILE,
@@ -143,6 +155,7 @@ ALL_EVENTS = frozenset({
     EVENT_DIVERGENCE,
     EVENT_TUNE_DECISION,
     EVENT_TUNE_TRIAL,
+    EVENT_EXCHANGE_ROUTE,
 })
 
 #: every registered name, any kind — what the lint checks literals against

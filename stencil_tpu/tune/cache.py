@@ -30,7 +30,13 @@ from typing import Optional, Tuple
 from stencil_tpu.tune.key import WorkloadKey
 from stencil_tpu.utils.config import env_str
 
-SCHEMA = 1
+#: bump when the persisted-config vocabulary changes incompatibly; a schema
+#: mismatch is a MISS (stale entries re-qualify, never crash).  History:
+#: 1 — depth/alias/layout/stream-plan configs (the autotuner PR);
+#: 2 — the ``exchange_route`` field (exchange-route PR): entries persisted
+#:     before the packed z-shell routes existed must not be consulted as if
+#:     they had compared against them.
+SCHEMA = 2
 
 _DEFAULT_DIR = os.path.join("~", ".cache", "stencil_tpu", "tune")
 

@@ -41,7 +41,10 @@ class WorkloadKey:
     n_fields: int
     mesh: Tuple[int, int, int]
     radius: int
-    route: str  # "jacobi-wrap" | "jacobi-wavefront" | "stream" | ...
+    route: str  # "jacobi-wrap" | "jacobi-wavefront" | "stream" | "exchange"
+    # | ... — "exchange" keys the halo-exchange route search, whose persisted
+    # config carries the ``exchange_route`` field (tune/space.py
+    # ``exchange_space``; consulted by DistributedDomain.realize)
 
     def to_dict(self) -> dict:
         return {

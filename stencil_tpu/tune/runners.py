@@ -203,6 +203,58 @@ def autotune_jacobi_wavefront(
     return report
 
 
+def autotune_exchange(
+    dd,
+    reps: int = 3,
+    rt: Optional[float] = None,
+) -> TuneReport:
+    """Tune the halo exchange's z-sweep route (direct vs the packed z-shell
+    routes — ops/exchange.py ``EXCHANGE_ROUTES``) for a REALIZED domain.
+    Each candidate is a non-donating exchange compiled over the domain's
+    live buffers, looped device-side (the ``exchange_many`` protocol) and
+    measured under the burst-aware alternating rounds; the domain's state is
+    never advanced (exchanging is idempotent on a filled domain).  The
+    winner feeds the very next ``realize()`` of this workload via the
+    persistent cache — ``DistributedDomain._resolve_exchange_route``
+    consults it, with ``direct`` as the static cold-cache fallback."""
+    import jax
+    from functools import partial as _partial
+
+    from jax import lax
+
+    key = dd.tune_key("exchange")
+    candidates, prefiltered = space.exchange_space(dd)
+    fns = {}  # keep every candidate's executable resident for the rounds
+
+    def build_run(cand):
+        route = cand["exchange_route"]
+        fn = dd.make_exchange_route_fn(route, donate=False)
+        fns[route] = fn
+
+        @_partial(jax.jit, static_argnums=1)
+        def many(arrays, s):
+            return lax.fori_loop(0, s, lambda _, a: fn(a), arrays)
+
+        def run(n):
+            out = many(dd._curr, n)
+            _force_done(next(iter(out.values())))
+
+        return run
+
+    report = tune.ensure(
+        key,
+        candidates,
+        build_run,
+        depth_key=None,
+        static={"exchange_route": "direct"},
+        reps=reps,
+        rt=rt,
+        prefiltered=prefiltered,
+    )
+    fns.clear()
+    return report
+
+
 def autotune_stream(
     dd,
     kernel,

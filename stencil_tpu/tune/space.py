@@ -11,6 +11,11 @@ Axes (ISSUE: the constants PERF_NOTES.md says to re-qualify per chip):
   pipeline is VPU-bound there); a faster-VPU generation flips it.
 * **stream route** (wrap/plane/wavefront) and grouping — the generic
   engine's plan axes.
+* **exchange route** (direct/zpack_xla/zpack_pallas) — the halo exchange's
+  z-sweep implementation: the sliced thin-z sliver vs the packed lane-major
+  z-shell message (ops/exchange.py EXCHANGE_ROUTES); ``direct`` is the
+  static fallback, the packed routes attack THE measured cost driver of
+  shell-carrying halo storage (PERF_NOTES "Thin z-region access").
 * **halo multiplier** — for the temporally-blocked paths the multiplier IS
   the wavefront depth (the m-wide shell is exchanged every m steps), so the
   ``m`` axis covers it; candidate dicts carry ``halo_multiplier == m`` to
@@ -139,6 +144,33 @@ def jacobi_wavefront_space(
             }
         )
     return cands, 0
+
+
+def exchange_space(dd) -> Tuple[List[dict], int]:
+    """(candidates, prefiltered) over the halo exchange's z-sweep route
+    (``ops/exchange.py`` EXCHANGE_ROUTES) for a REALIZED domain: ``direct``
+    (the static fallback — the thin-z sliver path, ~64×-amplified on the
+    (8,128) tiling, PERF_NOTES "Thin z-region access") vs the two packed
+    z-shell routes (``zpack_xla`` / ``zpack_pallas``: lane-major ``(2m, Y,
+    Xpad)`` message buffers).  Packed candidates that structurally cannot
+    engage (uneven z split, unsupported dtype, no z shell at all) are
+    prefiltered — they count into ``tune.pruned`` without burning a trial."""
+    from stencil_tpu.ops.exchange import EXCHANGE_ROUTES, zpack_supported
+
+    cands: List[dict] = [{"exchange_route": "direct"}]
+    shell = dd._shell_radius
+    packed_ok = (
+        shell is not None
+        and (shell.axis(2, -1) > 0 or shell.axis(2, +1) > 0)
+        and zpack_supported([h.dtype for h in dd._handles], dd._valid_last)
+    )
+    prefiltered = 0
+    for route in EXCHANGE_ROUTES[1:]:
+        if packed_ok:
+            cands.append({"exchange_route": route})
+        else:
+            prefiltered += 1
+    return cands, prefiltered
 
 
 def stream_space(dd, x_radius: int, separable: bool, static_plan: dict) -> Tuple[List[dict], int]:

@@ -65,19 +65,36 @@ def test_astaroth_sim(capsys):
 
 
 def test_bench_exchange(capsys):
+    import json
+
     from stencil_tpu.bin.bench_exchange import main
 
-    assert main(["--iters", "2", "--x", "12", "--y", "12", "--z", "12"]) == 0
+    assert main(
+        ["--iters", "2", "--x", "12", "--y", "12", "--z", "12", "--ab-reps", "1"]
+    ) == 0
     out = _capture(capsys)
     assert out[0] == (
         "name,count,trimean (S),trimean (B/s),stddev,min,avg,max,trimean (B/s swept)"
     )
-    assert len(out) == 6  # header + 5 radius configs (bench_exchange.cu:121-195)
-    for line in out[1:]:
+    # header + 5 radius configs (bench_exchange.cu:121-195) + the JSON line
+    assert len(out) == 7
+    for line in out[1:6]:
         cols = line.split(",")
         assert float(cols[2]) > 0 and float(cols[3]) > 0
         # swept B/s >= modeled B/s: sweeps move full-extent slabs
         assert float(cols[8]) >= float(cols[3])
+    # the machine-readable route A/B: direct-vs-packed steady-state medians
+    # (alternating protocol) with the per-axis (x/y/z) ms breakdown
+    doc = json.loads(out[6])
+    ab = doc["route_ab"]
+    assert ab["measurement_protocol"]["drop_rep0"] is True
+    assert set(ab["routes"]) >= {"direct"}
+    for entry in ab["routes"].values():
+        assert entry["ms_per_exchange"] > 0
+        assert set(entry["per_axis_ms"]) == {"x", "y", "z"}
+    if ab["packed_eligible"]:
+        assert set(ab["routes"]) == {"direct", "zpack_xla", "zpack_pallas"}
+        assert set(ab["speedup_vs_direct"]) == {"zpack_xla", "zpack_pallas"}
 
 
 @pytest.mark.parametrize("backend", ["xla", "pallas"])

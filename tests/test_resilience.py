@@ -100,6 +100,10 @@ class TestClassify:
             "DEADLINE_EXCEEDED: deadline exceeded after 59.9s",
             "connection reset by peer",
             "tunnel handshake failed, try again later",
+            # the EXACT JaxRuntimeError wording behind BENCH_r05.json's rc=1
+            # (realize()'s eager exchange compile through the axon tunnel)
+            "INTERNAL: http://127.0.0.1:8113/remote_compile: read body: "
+            "response body closed before all bytes were read",
         ):
             assert classify(RuntimeError(msg)) is FailureClass.TRANSIENT_RUNTIME, msg
 

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Multi-chip weak-scaling sweep — one overlap-A/B JSON artifact per mesh.
+
+The MULTICHIP_r* successor with a real schema: for each mesh shape in the
+sweep ([2,1,1] → [2,2,2] by default) this runner invokes
+
+    python -m stencil_tpu.bin.weak X Y Z ITERS --overlap --mesh MX,MY,MZ \
+        --json <out>/weak_MXxMYxMZ.json [--exchange-route R] [--tune]
+
+as a SUBPROCESS (each mesh gets a fresh backend: device restriction and the
+forced partition must not leak between shapes), collects the per-mesh
+documents (per-mesh Mcells/s, exchange ms, split-vs-off overlap delta —
+bin/weak.py ``run_overlap``), and writes a sweep summary
+``weak_scaling_summary.json`` with the weak-scaling efficiency of each mesh
+against the first.
+
+Hardware mode (default) uses the host's real TPU devices — a mesh needing
+more chips than present is skipped with a note, so the same command works on
+a v5e-4 and a v5e-8.  ``--dryrun`` forces the CPU backend with exactly
+``MX*MY*MZ`` fake host devices per mesh and a small per-chip base, so the
+whole sweep (and its schema) is exercised on any machine; artifacts are
+tagged ``"dryrun": true`` by the driver.
+
+512³/chip on real hardware:
+
+    python scripts/run_weak_scaling.py --base 512 512 512 --iters 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+DEFAULT_MESHES = ("2,1,1", "2,2,1", "2,2,2")
+
+
+def mesh_tuple(spec: str):
+    parts = [int(v) for v in spec.split(",")]
+    if len(parts) != 3 or any(v < 1 for v in parts):
+        raise argparse.ArgumentTypeError(
+            f"mesh wants MX,MY,MZ positive ints, got {spec!r}"
+        )
+    return tuple(parts)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "run_weak_scaling",
+        description="per-mesh overlap-A/B weak-scaling sweep (see module docstring)",
+    )
+    p.add_argument(
+        "--meshes",
+        nargs="+",
+        default=list(DEFAULT_MESHES),
+        metavar="MX,MY,MZ",
+        help=f"mesh shapes to sweep (default: {' '.join(DEFAULT_MESHES)})",
+    )
+    p.add_argument(
+        "--base",
+        nargs=3,
+        type=int,
+        default=[512, 512, 512],
+        metavar=("X", "Y", "Z"),
+        help="per-chip base size (weak-scaled per axis by the mesh dims)",
+    )
+    p.add_argument("--iters", type=int, default=30, help="driver n_iters")
+    p.add_argument("--ab-reps", type=int, default=3)
+    p.add_argument("--halo-mult", type=int, default=2)
+    p.add_argument("--quantities", type=int, default=1)
+    p.add_argument(
+        "--exchange-route",
+        default="auto",
+        choices=("auto", "direct", "zpack_xla", "zpack_pallas"),
+    )
+    p.add_argument(
+        "--tune",
+        action="store_true",
+        help="pass --tune through: each mesh searches its exchange-route "
+        "and stream-plan (incl. overlap) axes first (cached per workload)",
+    )
+    p.add_argument(
+        "--out-dir",
+        default="weak_scaling_out",
+        metavar="DIR",
+        help="artifact directory (one weak_MXxMYxMZ.json per mesh + summary)",
+    )
+    p.add_argument(
+        "--dryrun",
+        action="store_true",
+        help="CPU backend with fake devices per mesh and a 16^3/chip base — "
+        "exercises the sweep + schema anywhere (numbers are not perf)",
+    )
+    return p
+
+
+def run_mesh(mesh, args, out_path: str) -> dict | None:
+    mx, my, mz = mesh
+    base = [16, 16, 16] if args.dryrun else list(args.base)
+    cmd = [
+        sys.executable,
+        "-m",
+        "stencil_tpu.bin.weak",
+        *(str(v) for v in base),
+        str(args.iters),
+        "--overlap",
+        "--mesh",
+        f"{mx},{my},{mz}",
+        "--json",
+        out_path,
+        "--ab-reps",
+        str(args.ab_reps),
+        "--halo-mult",
+        str(args.halo_mult),
+        "--quantities",
+        str(args.quantities),
+    ]
+    if args.exchange_route != "auto":
+        cmd += ["--exchange-route", args.exchange_route]
+    if args.tune:
+        cmd.append("--tune")
+    env = dict(os.environ)
+    if args.dryrun:
+        n = mx * my * mz
+        flags = env.get("XLA_FLAGS", "")
+        # replace any inherited forced-device-count with this mesh's
+        flags = " ".join(
+            f for f in flags.split() if "xla_force_host_platform_device_count" not in f
+        )
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    if proc.returncode != 0:
+        print(proc.stdout, file=sys.stderr)
+        print(proc.stderr, file=sys.stderr)
+        raise SystemExit(f"mesh {mesh}: driver failed (rc={proc.returncode})")
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def probe_device_count() -> "int | None":
+    """Host device count, probed in a THROWAWAY subprocess: importing jax and
+    touching ``jax.devices()`` here would leave the parent holding the TPU
+    for the sweep's whole lifetime, and every per-mesh driver subprocess
+    would then fail init ("The TPU is already in use by process ...") — the
+    one process allowed to own the chips is the driver itself."""
+    probe = subprocess.run(
+        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        capture_output=True,
+        text=True,
+    )
+    if probe.returncode != 0:
+        return None
+    try:
+        return int(probe.stdout.strip())
+    except ValueError:
+        return None
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    meshes = [mesh_tuple(m) for m in args.meshes]
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    have = None if args.dryrun else probe_device_count()
+    results = []
+    for mesh in meshes:
+        need = mesh[0] * mesh[1] * mesh[2]
+        if not args.dryrun:
+            if have is not None and need > have:
+                print(
+                    f"mesh {mesh}: needs {need} chips, have {have} — skipped",
+                    file=sys.stderr,
+                )
+                continue
+        out_path = os.path.join(
+            args.out_dir, f"weak_{mesh[0]}x{mesh[1]}x{mesh[2]}.json"
+        )
+        print(f"== mesh {mesh} -> {out_path}", file=sys.stderr)
+        doc = run_mesh(mesh, args, out_path)
+        results.append(doc)
+
+    if not results:
+        print("no mesh ran (not enough devices?)", file=sys.stderr)
+        return 1
+
+    # weak-scaling summary: per-chip throughput of each mesh vs the first —
+    # ideal weak scaling holds mcells_per_s_per_chip flat as chips grow
+    base_doc = results[0]
+
+    def per_chip(doc, ov):
+        return doc["overlap"][ov]["mcells_per_s_per_chip"]
+
+    summary = {
+        "bench": "weak_scaling_sweep",
+        "dryrun": results[0]["dryrun"],
+        "base_per_chip": base_doc["cells_per_chip"],
+        "meshes": [
+            {
+                "mesh": doc["mesh"],
+                "chips": doc["chips"],
+                "global": doc["global"],
+                "exchange_route": doc["exchange_route"],
+                "mcells_per_s_per_chip": {
+                    ov: per_chip(doc, ov) for ov in ("off", "split")
+                },
+                "exchange_ms": doc["exchange"]["ms_per_exchange"],
+                "split_speedup": doc["split_speedup"],
+                "weak_efficiency": {
+                    ov: (
+                        per_chip(doc, ov) / per_chip(base_doc, ov)
+                        if per_chip(doc, ov) and per_chip(base_doc, ov)
+                        else None
+                    )
+                    for ov in ("off", "split")
+                },
+            }
+            for doc in results
+        ],
+    }
+    path = os.path.join(args.out_dir, "weak_scaling_summary.json")
+    with open(path, "w") as f:
+        json.dump(summary, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -125,6 +125,22 @@ def apply_exchange_route(args, dd) -> None:
         dd.set_exchange_route(route)
 
 
+def add_stream_overlap_flag(p: argparse.ArgumentParser) -> None:
+    """``--stream-overlap``: pin the stream engine's split-step overlap
+    schedule for this run (docs/tuning.md "Stream overlap").  ``auto``
+    (default) keeps the planner resolution: ``STENCIL_STREAM_OVERLAP`` >
+    tuned config > the static ``off``."""
+    p.add_argument(
+        "--stream-overlap",
+        default="auto",
+        choices=("auto", "off", "split"),
+        help="stream-engine overlap schedule: off = exchange-then-compute, "
+        "split = interior pass concurrent with the shell ppermutes plus a "
+        "narrow exterior fix-up (bitwise-identical; auto = env > tuned "
+        "config > off)",
+    )
+
+
 def tune_begin(args) -> None:
     """Apply the ``add_tune_flags`` choices to the tune facade; call right
     after ``parse_args`` (before any model/planner construction).  Pair

@@ -57,6 +57,7 @@ def main(argv=None) -> int:
     )
     _common.add_telemetry_flags(p)
     _common.add_tune_flags(p)
+    _common.add_stream_overlap_flag(p)
     args = p.parse_args(argv)
     _common.telemetry_begin(args)
     _common.tune_begin(args)
@@ -122,6 +123,7 @@ def _run(args) -> int:
         kernel_impl=kernel_impl,
         interpret=jax.default_backend() == "cpu",
         schedule=args.schedule,
+        stream_overlap=args.stream_overlap,
     )
     sim.realize()
     sim.step()  # compile

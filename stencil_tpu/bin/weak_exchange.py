@@ -25,7 +25,7 @@ from stencil_tpu.utils.config import MethodFlags
 
 
 def main(argv=None) -> int:
-    args = build_parser("weak-exchange").parse_args(argv)
+    args = build_parser("weak-exchange", overlap_flags=False).parse_args(argv)
     args.trivial = args.naive
     _common.telemetry_begin(args)
     devs = len(jax.devices())
@@ -38,6 +38,7 @@ def main(argv=None) -> int:
     dd.set_methods(_common.parse_methods(args))
     dd.set_radius(Radius.constant(3))
     dd.set_placement(_common.parse_strategy(args))
+    _common.apply_exchange_route(args, dd)
     for i in range(4):
         dd.add_data(f"d{i}", dtype=jnp.float32)
     dd.realize()

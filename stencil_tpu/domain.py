@@ -981,6 +981,11 @@ class DistributedDomain:
         stream_depth: int = None,  # stream engine: cap the temporal depth
         # (auto maximizes it — the right call for bandwidth-bound kernels,
         # wrong for compute-heavy ones, whose VPU work scales with depth)
+        stream_overlap: str = "auto",  # stream engine: split-step overlap
+        # schedule (ops/stream.py STREAM_OVERLAP): "split" dispatches the
+        # interior pass with no data dependency on the shell ppermutes and
+        # recomputes the boundary bands from fresh halos afterward —
+        # bitwise-identical to "off"; "auto" resolves env > tuned > off
         interpret: bool = False,  # stream engine only: pallas interpret mode
     ):
         """Build ``step(curr) -> next`` fusing exchange + compute.
@@ -1011,9 +1016,11 @@ class DistributedDomain:
           the max user radius) and no N-D component data.  This is how USER
           stencils reach the flagship paths' speed — the reference's
           user-kernel model (accessor.hpp:13-40) where the cache hierarchy
-          is an explicit plane ring.  ``overlap`` is not meaningful there
-          (the macro is one fused pass); ``stream_depth`` caps the temporal
-          depth for compute-heavy kernels.
+          is an explicit plane ring.  The ``overlap`` flag is the XLA
+          engine's; the stream engine's split-step schedule is selected by
+          ``stream_overlap`` instead ("off" | "split" | "auto" — a tuner
+          axis, see docs/tuning.md "Stream overlap"); ``stream_depth`` caps
+          the temporal depth for compute-heavy kernels.
         """
         assert self._realized
         if engine == "stream":
@@ -1027,7 +1034,7 @@ class DistributedDomain:
             return make_stream_step(
                 self, kernel, x_radius=x_radius, path=stream_path,
                 separable=separable, interpret=interpret, donate=donate,
-                max_depth=stream_depth,
+                max_depth=stream_depth, overlap=stream_overlap,
             )
         if engine != "xla":
             raise ValueError(f"unknown engine {engine!r}")

@@ -65,6 +65,9 @@ class AstarothSim:
         # astaroth_sim.cu:223-274)
         check_divergence_every: int = 0,  # divergence sentinel cadence
         # (resilience/sentinel.py); 0 = off
+        stream_overlap: str = "auto",  # pallas engine only: the stream
+        # engine's split-step overlap schedule (ops/stream.py
+        # STREAM_OVERLAP; "auto" = env > tuned > static off)
     ):
         self.dd = DistributedDomain(x, y, z)
         self.dd.set_radius(Radius.constant(3))  # astaroth_sim.cu:184
@@ -81,6 +84,7 @@ class AstarothSim:
         if schedule not in ("auto", "per-step", "wavefront"):
             raise ValueError(f"unknown schedule {schedule!r}")
         self.schedule = schedule
+        self.stream_overlap = stream_overlap
         if check_divergence_every:
             self.dd.set_divergence_check(check_divergence_every)
         self._step = None
@@ -121,6 +125,7 @@ class AstarothSim:
                 # runs may stream per-field at full wavefront depth
                 separable=True,
                 interpret=self.interpret,
+                stream_overlap=self.stream_overlap,
             )
         else:
             if self.schedule == "wavefront":

@@ -227,15 +227,42 @@ def _make_roll(interpret: bool):
     once at its existing per-level astype — better accuracy than a narrow
     sum and fewer converts than a per-roll round trip (Mosaic CSEs the
     repeated upcast of the same plane).  8-byte dtypes are not silently
-    truncated; they fail loudly in Mosaic."""
+    truncated; they fail loudly in Mosaic.
+
+    Mosaic additionally rejects its rotate on planes that are not natively
+    tiled ("unsupported unaliged shape": second-minor % 8 / minor % 128 for
+    the 32-bit tiling) — exactly the shape class of shell-padded multi-chip
+    blocks (e.g. 132x132 raw planes) and the split-step overlap schedule's
+    narrow band sub-blocks (ops/stream.py).  A STATIC python amount (stencil
+    offsets, wrap closures — every streaming-kernel site) on an unaligned
+    plane therefore takes an equivalent two-static-slices + concatenate form
+    instead, which Mosaic accepts at any alignment; aligned planes keep the
+    single rotate instruction (the measured single-chip fast path), and
+    TRACED amounts (the slab route's per-plane column rotate) have no
+    static-slice form and stay on Mosaic's rotate."""
     from jax.experimental.pallas import tpu as pltpu
 
     def roll(v, amt, axis):
         if interpret:
             return jnp.roll(v, amt, axis)
         if v.dtype.itemsize < 4 and jnp.issubdtype(v.dtype, jnp.floating):
-            return pltpu.roll(v.astype(jnp.float32), amt % v.shape[axis], axis)
-        return pltpu.roll(v, amt % v.shape[axis], axis)
+            v = v.astype(jnp.float32)
+        aligned = v.shape[-1] % 128 == 0 and (
+            v.ndim < 2 or v.shape[-2] % 8 == 0
+        )
+        if aligned or not isinstance(amt, int):
+            return pltpu.roll(v, amt % v.shape[axis], axis)
+        n = v.shape[axis]
+        k = amt % n
+        if k == 0:
+            return v
+        return jax.lax.concatenate(
+            [
+                jax.lax.slice_in_dim(v, n - k, n, axis=axis),
+                jax.lax.slice_in_dim(v, 0, n - k, axis=axis),
+            ],
+            dimension=axis,
+        )
 
     return roll
 

@@ -28,13 +28,52 @@ Two routes, chosen by ``make_stream_step``:
 The engine is bit-compatible with the XLA route: both call the user kernel
 with the same per-cell arithmetic, so outputs agree exactly (modulo compiler
 excess precision, which the interpret-mode tests pin).
+
+**Split-step overlap schedule** (``overlap ∈ {off, split}``, a tuner axis —
+docs/tuning.md "Stream overlap"): the exchange-then-compute macro serializes
+the packed shell ppermutes against the whole pass.  Under ``split`` the
+macro is restructured so XLA's latency-hiding scheduler can fly the
+collectives behind the bulk of the VPU work (the reference's L6
+interior/exterior orchestration, src/stencil.cu:567-666; T3/arxiv
+2401.16677 is the modern treatment):
+
+* the **interior pass** is the unchanged full-block pass run on the
+  PRE-exchange blocks — it carries no data dependency on any ppermute, so
+  the scheduler issues ``collective-permute-start`` before it and ``-done``
+  after it.  Cells within the dependency cone of the (stale) shell compute
+  garbage there, by design;
+* the **exterior passes** recompute exactly that boundary band — six narrow
+  sub-block passes (width ``3w`` rounded up to the axis tile granule,
+  ``w = m·r``) over the freshly exchanged blocks, running the SAME pallas
+  kernels so every recomputed cell is
+  bitwise identical to the off-schedule value — and blend the width-``w``
+  bands back tile-locally (``ops/halo_blend``; x bands are contiguous
+  plane DUS).
+
+Correctness rests on two invariants the tier-1 suites pin: (a) a cell at
+distance ≥ ``w`` from the shell has a per-level dependency cone that never
+reads shell values, so interior-pass values equal off-schedule values
+bitwise; (b) the 3-sweep exchange's output halos depend only on interior
+values — each sweep's surviving writes come from interior slabs or halos
+freshly written by an earlier sweep of the same exchange — so the stale
+shell the split schedule carries between macros can never leak into any
+valid cell.  Shell cells of a split-step output differ from the off
+schedule (stale pass-through vs fresh), which is already sacrificial state:
+stream steps mark the shell stale and every consumer re-exchanges.
+
+Structurally ``split`` engages on the ``plane`` and plain ``wavefront``
+routes; ``wrap`` has no exchange to hide and the z-slab wavefront
+interleaves its slab permutes with the pass, so both degrade to ``off``
+with a warning.  Padded (uneven) shards ARE supported: the high-side band
+offsets ride the same traced ``n_valid`` arithmetic as the exchange's
+dynamic halo blends.
 """
 
 from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Callable, Dict, List, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +81,8 @@ from jax import lax
 
 from stencil_tpu.core.dim3 import Dim3
 from stencil_tpu.utils.compat import shard_map
+from stencil_tpu import telemetry
+from stencil_tpu.telemetry import names as tm
 from stencil_tpu.ops.jacobi_pallas import (
     _make_roll,
     _padded_plane_bytes,
@@ -50,6 +91,13 @@ from stencil_tpu.ops.jacobi_pallas import (
     _VMEM_STACK_MARGIN,
     _WRAP_MAX_K,
 )
+
+
+#: overlap schedules for the exchanging stream routes — a first-class tuner
+#: axis (tune/space.py ``stream_space``; docs/tuning.md "Stream overlap"):
+#: ``off`` = exchange-then-compute (the static fallback), ``split`` = the
+#: interior/exterior split-step schedule (see module docstring).
+STREAM_OVERLAP = ("off", "split")
 
 
 class PlaneView:
@@ -481,11 +529,20 @@ def _tuned_stream_plan(dd, x_radius: int, separable: bool) -> dict:
     }
     if cfg.get("alias") is not None:
         plan["alias"] = bool(cfg["alias"])
+    # the overlap axis joined the persisted vocabulary WITHOUT a schema bump:
+    # pre-overlap (v2-era) entries simply lack the key, and the resolver
+    # falls through to the static ``off`` — warm caches stay warm.  A
+    # present-but-garbage value invalidates the plan below (miss to static,
+    # never a crash), like any other hand-edited field.
+    if cfg.get("overlap") is not None:
+        plan["overlap"] = cfg["overlap"]
     n = dd.local_spec().sz
     shell = dd._shell_radius
     lo, hi = shell.lo(), shell.hi()
     padded = any(v is not None for v in dd._valid_last)
     ok = isinstance(m, int) and m >= 1
+    if ok and plan.get("overlap") is not None:
+        ok = plan["overlap"] in STREAM_OVERLAP
     if ok and plan["grouping"] == "per-field":
         ok = separable and len(dd._handles) > 1
     elif ok and plan["grouping"] != "joint":
@@ -751,6 +808,109 @@ def _resolve_stream_alias(plan: dict, n_fields: int) -> bool:
     return n_fields >= 4
 
 
+def _overlap_request(plan: dict) -> Tuple[str, str]:
+    """Pre-structural (value, source) of a stream plan's overlap schedule.
+    Precedence mirrors the exchange route and stream alias rules: a FORCED
+    plan value (``overlap_forced`` — explicit ``make_step(stream_overlap=
+    ...)``/``make_stream_step(overlap=...)`` requests, autotuner candidate
+    builds, and the ladder's split→off step-down, none of which ever consult
+    further) > ``STENCIL_STREAM_OVERLAP`` (validated read) > the plan's
+    tuned ``overlap`` > the static ``off``."""
+    from stencil_tpu.utils.config import env_choice
+
+    val: Optional[str] = None
+    source = "static"
+    if plan.get("overlap_forced") and plan.get("overlap") is not None:
+        val, source = plan["overlap"], "explicit"
+        if val not in STREAM_OVERLAP:
+            raise ValueError(
+                f"unknown stream overlap {val!r} (one of {STREAM_OVERLAP})"
+            )
+    else:
+        env = env_choice(
+            "STENCIL_STREAM_OVERLAP", "auto", ("auto",) + STREAM_OVERLAP
+        )
+        if env != "auto":
+            val, source = env, "env"
+        elif plan.get("overlap") is not None:
+            tuned = plan["overlap"]
+            if tuned in STREAM_OVERLAP:
+                val, source = str(tuned), "tuned"
+            else:
+                from stencil_tpu.utils.logging import log_warn
+
+                log_warn(
+                    f"tuned stream overlap {tuned!r} is not one of "
+                    f"{STREAM_OVERLAP}; using the static 'off' fallback"
+                )
+    if val is None:
+        val = "off"
+    return val, source
+
+
+def _resolve_stream_overlap(plan: dict) -> Tuple[str, str]:
+    """``_overlap_request`` plus the structural guard: a ``split`` the plan
+    cannot serve — the wrap route has no exchange to hide, the z-slab
+    wavefront interleaves its slab permutes with the pass — degrades to
+    ``off`` with a warning (source tagged ``/degraded``), never an error: a
+    stale persisted config or a cross-route env var must not kill a run
+    ``off`` could have served.  (``make_stream_step`` re-plans a z-slab
+    wavefront to the plain form BEFORE this guard when split was requested,
+    so the degrade here is the last resort, not the common path.)"""
+    val, source = _overlap_request(plan)
+    if val == "split" and (
+        plan.get("route") not in ("plane", "wavefront") or plan.get("z_slabs")
+    ):
+        from stencil_tpu.utils.logging import log_warn
+
+        why = (
+            "the z-slab wavefront interleaves its slab permutes with the pass"
+            if plan.get("z_slabs")
+            else f"the {plan.get('route')!r} route has no exchange to hide"
+        )
+        log_warn(
+            f"overlap=split ({source}) cannot engage here ({why}); "
+            "degrading to overlap=off"
+        )
+        val, source = "off", source + "/degraded"
+    return val, source
+
+
+def plain_wavefront_plan(dd, plan: dict, max_depth: Optional[int] = None) -> Optional[dict]:
+    """The PLAIN-form twin of a z-slab wavefront plan, at the deepest depth
+    the VMEM model fits (the z-slab blocks leave the budget; the unpadded
+    ``raw.z`` planes enter it) — or None when no plain depth >= 2 fits.
+    The split-step schedule needs it: z halos must live in the big array for
+    the exchange the interior pass overlaps, and the packed ``zpack_*``
+    exchange routes already de-amplified the thin-z traffic the z-slab form
+    exists to dodge.  Shared by ``make_stream_step`` (a split request
+    re-plans through it) and ``tune/space.py`` (the split candidate)."""
+    if plan.get("route") != "wavefront" or not plan.get("z_slabs"):
+        return None
+    shell = dd._shell_radius
+    s = shell.lo().x
+    raw = dd.local_spec().raw_size()
+    itemsizes = [h.dtype.itemsize for h in dd._handles]
+    sizes = (
+        [max(itemsizes)]
+        if plan.get("grouping") == "per-field" and len(itemsizes) > 1
+        else itemsizes
+    )
+    cap = min(s, _WRAP_MAX_K)
+    if max_depth is not None:
+        cap = min(cap, max_depth)
+    m = 0
+    for cand in range(2, cap + 1):
+        if stream_vmem_fits(cand, raw.y, raw.z, sizes, False):
+            m = cand
+    if m < 2:
+        return None
+    out = dict(plan)
+    out["z_slabs"] = False
+    out["m"] = m
+    return out
+
+
 def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
     from jax.sharding import PartitionSpec as P
 
@@ -785,6 +945,117 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
     # un-aliased at 8x512^3 (19.1 vs 12.8 ms/iter, r5 bench) — the per-pass
     # allocate/free churn costs more than the aliasing serialization saves.
     alias = _resolve_stream_alias(plan, len(names))
+    # split-step overlap schedule (module docstring): resolve, write the
+    # decision back into the plan (the ladder and step._stream_plan read it),
+    # and record it — the stream-engine twin of the exchange.route event
+    overlap, overlap_source = _resolve_stream_overlap(plan)
+    plan["overlap"] = overlap
+    telemetry.emit_event(
+        tm.EVENT_STEP_OVERLAP,
+        overlap=overlap,
+        source=overlap_source,
+        route=plan["route"],
+        m=plan["m"],
+    )
+    split = overlap == "split"
+
+    if split:
+        from stencil_tpu.ops import halo_blend
+
+        interp_blend = interpret or halo_blend.interpret_mode()
+        lo_t = (lo.x, lo.y, lo.z)
+        hi_t = (hi.x, hi.y, hi.z)
+
+        def _n_valid(ax):
+            """Valid interior width on ``ax`` for THIS shard: a plain int on
+            even axes, traced on padded ones (the last shard owns the
+            remainder — the same arithmetic as the exchange's dynamic halo
+            offsets, so band positions land exactly where the halos did)."""
+            if valid_last[ax] is None:
+                return n[ax]
+            idx = lax.axis_index(MESH_AXES[ax])
+            return jnp.where(
+                idx == mesh_shape[ax] - 1, valid_last[ax], n[ax]
+            ).astype(jnp.int32)
+
+        def _starts3(ax, start):
+            # uniform index dtype: a traced (int32) padded-axis offset must
+            # not mix with python-int (x64) zeros in dynamic_slice/DUS
+            starts = [jnp.int32(0)] * 3
+            starts[ax] = jnp.asarray(start, jnp.int32)
+            return tuple(starts)
+
+        def _sub_slice(b, ax, start, width):
+            sizes = list(b.shape)
+            sizes[ax] = width
+            return lax.dynamic_slice(b, _starts3(ax, start), tuple(sizes))
+
+        def _blend_band(block, band, ax, pos):
+            """Write a recomputed width-``w`` band at ``pos`` along ``ax``.
+            x bands are whole contiguous planes (DUS at slab cost); y/z bands
+            go through the tile-local blend kernels exactly like the
+            exchange's halo writes (static offset on even axes, traced on
+            padded ones)."""
+            if ax == 0:
+                # stencil-lint: disable=sliver-dus x-plane band write-back: whole contiguous planes, the exchange's sanctioned axis-0 pattern (no relayout bait)
+                return lax.dynamic_update_slice(block, band, _starts3(0, pos))
+            if not halo_blend.supports(block.dtype):
+                # exotic-dtype correctness fallback, off the measured path
+                # stencil-lint: disable=sliver-dus exotic-dtype (no known tile geometry) fallback — the blend kernels cannot engage, and such dtypes are off the measured fast path
+                return lax.dynamic_update_slice(block, band, _starts3(ax, pos))
+            if isinstance(pos, int):
+                return halo_blend.blend_slab(
+                    block, band, ax, pos, interpret=interp_blend
+                )
+            return halo_blend.blend_slab_dynamic(
+                block, band, ax, pos, interpret=interp_blend
+            )
+
+        # Mosaic rejects thin band sub-blocks outright (a 6-sublane ring
+        # scratch is an "invalid offsets in tiling target"; thin-lane shapes
+        # likewise): the band window is rounded up to the axis tile granule
+        # — 32 sublanes / 128 lanes cover the native tiling of every dtype —
+        # which costs nothing the VMEM tile padding wasn't already paying
+        # (PERF_NOTES "Thin z-region access": a 6-lane sliver occupies full
+        # 128-lane tiles regardless).  x slices whole planes (the grid
+        # axis — no granule).  Interpret mode pads identically so tier-1
+        # exercises the same window arithmetic the TPU compiles.
+        _BAND_GRANULE = (1, 32, 128)
+
+        def _band_window(ax, start, w, raw_ax):
+            """(clamped start, width) of one band's support window: ``3w``
+            rounded up to the axis granule, slid down (never past 0) to stay
+            inside the raw extent.  The clamp only widens the interior side
+            of the window, so the band keeps its full dependency cone."""
+            g = _BAND_GRANULE[ax]
+            width = min(-(-3 * w // g) * g, raw_ax)
+            if isinstance(start, int):
+                return max(min(start, raw_ax - width), 0), width
+            return jnp.clip(start, 0, raw_ax - width), width
+
+        def _exterior_fix(outs, ex, w, origin, narrow_pass):
+            """Recompute the six width-``w`` boundary bands of ``outs`` from
+            the freshly exchanged blocks ``ex`` and blend them in.  Each
+            band's support window is ``>= 3w`` wide (band + ``w`` of fresh
+            shell + interior, granule-padded), so the narrow pass reproduces
+            the full pass's values bitwise on the band; band overlaps at
+            edges and corners write identical values twice."""
+            outs = list(outs)
+            for ax in range(3):
+                nv = _n_valid(ax)
+                for start, pos in (
+                    (lo_t[ax] - w, lo_t[ax]),  # low face: static offsets
+                    # high face: right after this shard's valid cells —
+                    # static on even axes, traced on padded ones
+                    (lo_t[ax] + nv - 2 * w, lo_t[ax] + nv - w),
+                ):
+                    start, width = _band_window(ax, start, w, ex[0].shape[ax])
+                    subs = [_sub_slice(e, ax, start, width) for e in ex]
+                    sub_outs = narrow_pass(subs, ax, start, w, origin)
+                    for q in range(len(outs)):
+                        band = _sub_slice(sub_outs[q], ax, pos - start, w)
+                        outs[q] = _blend_band(outs[q], band, ax, pos)
+            return outs
 
     def origin_of():
         # NOTE: must be called INSIDE the fori_loop body that consumes it.
@@ -830,26 +1101,79 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
 
     elif plan["route"] == "plane":
 
-        def per_shard(steps, *blocks):
-            def body(_, bs):
-                origin = origin_of()
-                bs = list(
-                    halo_exchange_multi(
-                        bs, shell, mesh_shape, valid_last=valid_last,
-                        route=exch_route,
-                    )
+        def plane_groups(bs, origin):
+            out = list(bs)
+            for g in groups:
+                outs = stream_plane_pass(
+                    kernel, [names[q] for q in g], [bs[q] for q in g],
+                    lo, hi, x_radius, origin, gsize, interpret=interpret,
                 )
-                out = list(bs)
+                for q, o in zip(g, outs):
+                    out[q] = o
+            return out
+
+        if split:
+
+            def narrow_plane(subs, ax, start, w, origin):
+                """One kernel level over ``3w``-wide face sub-blocks (``w ==
+                x_radius``): the sliced axis carries a ``w``-deep pseudo
+                shell, the other axes keep the true shell widths, and the
+                origin shifts so wrapped coordinates match the full pass at
+                every sub-block position (traced on padded axes)."""
+                lo2 = Dim3(*[w if b == ax else lo_t[b] for b in range(3)])
+                hi2 = Dim3(*[w if b == ax else hi_t[b] for b in range(3)])
+                delta = [
+                    jnp.asarray(start - lo_t[b] + w if b == ax else 0, jnp.int32)
+                    for b in range(3)
+                ]
+                origin_sub = origin + jnp.stack(delta)
+                out = list(subs)
                 for g in groups:
                     outs = stream_plane_pass(
-                        kernel, [names[q] for q in g], [bs[q] for q in g],
-                        lo, hi, x_radius, origin, gsize, interpret=interpret,
+                        kernel, [names[q] for q in g], [subs[q] for q in g],
+                        lo2, hi2, x_radius, origin_sub, gsize,
+                        interpret=interpret,
                     )
                     for q, o in zip(g, outs):
                         out[q] = o
-                return tuple(out)
+                return out
 
-            return lax.fori_loop(0, steps, body, tuple(blocks))
+            def per_shard(steps, *blocks):
+                def body(_, bs):
+                    origin = origin_of()
+                    bs = list(bs)
+                    # the ppermutes read slabs of the PRE-exchange blocks;
+                    # the interior pass below also reads those blocks — no
+                    # data dependency between them, so XLA's latency-hiding
+                    # scheduler flies the collectives behind the pass
+                    ex = list(
+                        halo_exchange_multi(
+                            bs, shell, mesh_shape, valid_last=valid_last,
+                            route=exch_route,
+                        )
+                    )
+                    with telemetry.annotate(tm.SPAN_OVERLAP_INTERIOR):
+                        out = plane_groups(bs, origin)
+                    with telemetry.annotate(tm.SPAN_OVERLAP_EXTERIOR):
+                        out = _exterior_fix(out, ex, x_radius, origin, narrow_plane)
+                    return tuple(out)
+
+                return lax.fori_loop(0, steps, body, tuple(blocks))
+
+        else:
+
+            def per_shard(steps, *blocks):
+                def body(_, bs):
+                    origin = origin_of()
+                    bs = list(
+                        halo_exchange_multi(
+                            bs, shell, mesh_shape, valid_last=valid_last,
+                            route=exch_route,
+                        )
+                    )
+                    return tuple(plane_groups(bs, origin))
+
+                return lax.fori_loop(0, steps, body, tuple(blocks))
 
     else:
         m = plan["m"]
@@ -878,19 +1202,66 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
                         zouts[q] = z[j]
             return outs, zouts
 
+        def narrow_wavefront(subs, ax, start, w, origin):
+            """``w`` kernel levels over ``3w``-wide face sub-blocks (``w`` is
+            this macro's depth; the remainder macro passes a shallower one).
+            The sub-block's pseudo shell is ``w`` on every axis — minimal
+            support for a width-``w`` band at level ``w`` — with the origin
+            shifted so wrapped coordinates match the full pass."""
+            delta = [
+                jnp.asarray(start - lo_t[b] + w if b == ax else w - lo_t[b],
+                            jnp.int32)
+                for b in range(3)
+            ]
+            origin_sub = origin + jnp.stack(delta)
+            out = list(subs)
+            for g in groups:
+                o, _ = stream_wavefront_pass(
+                    kernel, [names[q] for q in g], [subs[q] for q in g],
+                    w, w, origin_sub, gsize, alias=False, interpret=interpret,
+                )
+                for q, oo in zip(g, o):
+                    out[q] = oo
+            return out
+
         def per_shard(steps, *blocks):
             if not z_slab_mode:
 
-                def macro(depth, bs):
-                    origin = origin_of()
-                    bs = list(
-                        halo_exchange_multi(
-                            bs, shell, mesh_shape, valid_last=valid_last,
-                            route=exch_route,
+                if split:
+
+                    def macro(depth, bs):
+                        origin = origin_of()
+                        bs = list(bs)
+                        # ppermutes on slabs of the PRE-exchange blocks; the
+                        # interior pass reads the same blocks — independent
+                        # dataflow, so the collectives fly behind the m-level
+                        # pass and only the narrow band passes wait for them
+                        ex = list(
+                            halo_exchange_multi(
+                                bs, shell, mesh_shape, valid_last=valid_last,
+                                route=exch_route,
+                            )
                         )
-                    )
-                    outs, _ = wavefront_groups(bs, depth, origin)
-                    return tuple(outs)
+                        with telemetry.annotate(tm.SPAN_OVERLAP_INTERIOR):
+                            outs, _ = wavefront_groups(bs, depth, origin)
+                        with telemetry.annotate(tm.SPAN_OVERLAP_EXTERIOR):
+                            outs = _exterior_fix(
+                                outs, ex, depth, origin, narrow_wavefront
+                            )
+                        return tuple(outs)
+
+                else:
+
+                    def macro(depth, bs):
+                        origin = origin_of()
+                        bs = list(
+                            halo_exchange_multi(
+                                bs, shell, mesh_shape, valid_last=valid_last,
+                                route=exch_route,
+                            )
+                        )
+                        outs, _ = wavefront_groups(bs, depth, origin)
+                        return tuple(outs)
 
                 macros, rem = divmod(steps, m)
                 bs = lax.fori_loop(0, macros, lambda _, b: macro(m, b), tuple(blocks))
@@ -951,6 +1322,7 @@ def make_stream_step(
     interpret: bool = False,
     donate: bool = True,
     max_depth: int = None,
+    overlap: str = "auto",
 ):
     """Build a ``step(curr, steps) -> curr`` running ``kernel`` under the
     plane-streaming engine — the fast-by-default path for user stencils
@@ -970,6 +1342,15 @@ def make_stream_step(
     (~bytes/k per cell) — correct for bandwidth-bound kernels, but a
     COMPUTE-heavy kernel (e.g. 27 taps/cell) multiplies its VPU work by the
     depth with nothing to amortize; cap it low (2-4) for such kernels.
+
+    ``overlap`` selects the split-step schedule (module docstring):
+    ``"auto"`` resolves ``STENCIL_STREAM_OVERLAP`` > the tuned config >
+    the static ``off``; an explicit ``"off"``/``"split"`` is an explicit
+    request and never consults further.  ``split`` is bitwise-identical to
+    ``off`` on every valid cell; a route it cannot serve (wrap, z-slab
+    wavefront) degrades to ``off`` with a warning, and a compile-rejected
+    split build steps down to ``off`` at the same depth through the ladder
+    before any depth descent.
 
     The returned step rides the resilience DEGRADATION LADDER
     (``resilience/ladder.py``): if Mosaic rejects the planned wavefront depth
@@ -1000,23 +1381,55 @@ def make_stream_step(
             )
     from stencil_tpu.resilience.ladder import DegradationLadder, Rung
 
+    if overlap not in ("auto",) + STREAM_OVERLAP:
+        raise ValueError(
+            f"unknown stream overlap {overlap!r} (one of "
+            f"{('auto',) + STREAM_OVERLAP})"
+        )
     plan = plan_stream(dd, x_radius, path, separable, max_m=max_depth)
+    if overlap != "auto":
+        plan = dict(plan)
+        plan["overlap"] = overlap
+        plan["overlap_forced"] = True
+    # a split request (explicit/env/tuned) against a z-slab wavefront plan
+    # re-plans to the PLAIN form when it fits: split needs z halos in the
+    # big array for the exchange it overlaps, and the packed zpack_* routes
+    # already de-amplified the thin-z traffic the slab form dodges.  When no
+    # plain depth fits, the build's structural guard degrades split -> off.
+    if _overlap_request(plan)[0] == "split":
+        plain = plain_wavefront_plan(dd, plan, max_depth=max_depth)
+        if plain is not None:
+            plan = plain
 
     def rung_for(p):
         # build() resolves _build_stream_step through module globals at call
         # time, so tests may monkeypatch it
+        suffix = ",split" if p.get("overlap") == "split" else ""
         return Rung(
-            name=f"{p['route']}[m={p['m']}]",
+            name=f"{p['route']}[m={p['m']}{suffix}]",
             build=lambda: _build_stream_step(dd, kernel, x_radius, p, interpret, donate),
             state={"plan": p},
         )
 
     def lower(rung, cls, exc):
         plan_now = rung.state["plan"]
-        if plan_now["route"] not in ("wavefront", "wrap") or plan_now["m"] <= 1:
-            return None  # plane route is the bottom rung — propagate
         from stencil_tpu.utils.logging import log_warn
 
+        if plan_now.get("overlap") == "split":
+            # first rung down: drop the split schedule at the SAME depth —
+            # the exterior passes carry their own scratch, so a VMEM_OOM or
+            # compile reject may be the overlap's fault, not the depth's
+            log_warn(
+                f"split-step overlap on {plan_now['route']}[m={plan_now['m']}] "
+                f"exceeded the compiler's capability ({cls.value}); stepping "
+                "down to overlap=off at the same depth"
+            )
+            p2 = dict(plan_now)
+            p2["overlap"] = "off"
+            p2["overlap_forced"] = True
+            return rung_for(p2)
+        if plan_now["route"] not in ("wavefront", "wrap") or plan_now["m"] <= 1:
+            return None  # plane route is the bottom rung — propagate
         new_max = plan_now["m"] - 1
         log_warn(
             f"{plan_now['route']} depth m={plan_now['m']} exceeded the "
@@ -1025,13 +1438,39 @@ def make_stream_step(
             "toolchain — consider recalibrating _VMEM_STACK_MARGIN / "
             "STENCIL_VMEM_LIMIT_BYTES)"
         )
-        return rung_for(plan_stream(dd, x_radius, path, separable, max_m=new_max))
+        p2 = dict(plan_stream(dd, x_radius, path, separable, max_m=new_max))
+        # a descent never re-enables split: carry the (post-split-step-down)
+        # overlap state into the shallower plan as a forced value
+        p2["overlap"] = plan_now.get("overlap", "off")
+        p2["overlap_forced"] = True
+        return rung_for(p2)
 
     ladder = DegradationLadder(rung_for(plan), lower=lower, label="stream")
 
+    raw = dd.local_spec().raw_size()
+    n_doms = dd.num_subdomains()
+    band_area = 2 * (raw.y * raw.z + raw.x * raw.z + raw.x * raw.y) * len(
+        dd._handles
+    ) * n_doms
+
+    def _exterior_cells(plan_now, steps: int) -> int:
+        """Analytic cells recomputed by the exterior band passes for this
+        dispatch (all shards, all fields) — 0 under ``overlap=off``."""
+        if plan_now.get("overlap") != "split":
+            return 0
+        if plan_now["route"] == "wavefront":
+            mm = plan_now["m"]
+            blocked, rem = divmod(steps, mm)
+            return band_area * (blocked * mm + rem)
+        return band_area * x_radius * steps
+
     def step(curr, steps: int = 1):
         out = ladder.step(curr, steps)
-        step._stream_plan = ladder.rung.state["plan"]
+        plan_now = ladder.rung.state["plan"]
+        step._stream_plan = plan_now
+        cells = _exterior_cells(plan_now, steps)
+        if cells:
+            telemetry.inc(tm.STEP_OVERLAP_EXTERIOR_CELLS, cells)
         return out
 
     step._marks_shell_stale = True

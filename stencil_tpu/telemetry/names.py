@@ -58,6 +58,11 @@ TUNE_SELECTED = "tune.selected"
 EXCHANGE_PACKED_BYTES = "exchange.packed.bytes"
 #: analytic pack+unpack kernel launches of those packed exchanges
 EXCHANGE_PACKED_KERNELS = "exchange.packed.kernels"
+#: analytic boundary-band cells RECOMPUTED by the split-step exterior passes
+#: (``overlap=split`` on the stream engine, ops/stream.py): the redundant
+#: surface work the overlapped schedule pays to free the interior pass from
+#: any ppermute dependency; 0 under ``overlap=off``
+STEP_OVERLAP_EXTERIOR_CELLS = "step.overlap.exterior_cells"
 
 ALL_COUNTERS = frozenset({
     EXCHANGE_COUNT,
@@ -77,6 +82,7 @@ ALL_COUNTERS = frozenset({
     TUNE_TRIALS,
     TUNE_PRUNED,
     TUNE_SELECTED,
+    STEP_OVERLAP_EXTERIOR_CELLS,
 })
 
 # --- gauges (last-value) -----------------------------------------------------
@@ -114,8 +120,21 @@ ALL_HISTOGRAMS = frozenset({
 SPAN_STEP = "domain.step"
 SPAN_EXCHANGE = "domain.exchange"
 SPAN_SWAP = "domain.swap"
+#: the split-step schedule's two halves (ops/stream.py overlap=split).  These
+#: are DEVICE-timeline spans: the split macro enters them as
+#: ``telemetry.annotate`` named scopes, so they label the interior stream
+#: pass / exterior band passes in compiled HLO metadata and XProf profiles —
+#: the tier-1/tier-2 overlap proofs key on the interior scope name.
+SPAN_OVERLAP_INTERIOR = "step.overlap.interior"
+SPAN_OVERLAP_EXTERIOR = "step.overlap.exterior"
 
-ALL_SPANS = frozenset({SPAN_STEP, SPAN_EXCHANGE, SPAN_SWAP})
+ALL_SPANS = frozenset({
+    SPAN_STEP,
+    SPAN_EXCHANGE,
+    SPAN_SWAP,
+    SPAN_OVERLAP_INTERIOR,
+    SPAN_OVERLAP_EXTERIOR,
+})
 
 # --- structured events (JSONL sink) ------------------------------------------
 
@@ -144,6 +163,10 @@ EVENT_TUNE_TRIAL = "tune.trial"
 #: source=explicit|env|tuned|static|ladder — or "<orig>/degraded" when a
 #: packed pick structurally could not engage)
 EVENT_EXCHANGE_ROUTE = "exchange.route"
+#: a stream-engine step build resolved its overlap schedule (fields:
+#: overlap=off|split, source=explicit|env|tuned|static|ladder or
+#: "<orig>/degraded" on a structural step-down, route, m)
+EVENT_STEP_OVERLAP = "step.overlap"
 
 ALL_EVENTS = frozenset({
     EVENT_COMPILE,
@@ -156,6 +179,7 @@ ALL_EVENTS = frozenset({
     EVENT_TUNE_DECISION,
     EVENT_TUNE_TRIAL,
     EVENT_EXCHANGE_ROUTE,
+    EVENT_STEP_OVERLAP,
 })
 
 #: every registered name, any kind — what the lint checks literals against

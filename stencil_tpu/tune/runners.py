@@ -264,8 +264,8 @@ def autotune_stream(
     reps: int = 3,
     rt: Optional[float] = None,
 ) -> TuneReport:
-    """Tune the generic stream engine's plan (route, depth, alias) for a
-    REALIZED domain + user kernel.  Trials run non-donating steps over the
+    """Tune the generic stream engine's plan (route, depth, alias, overlap)
+    for a REALIZED domain + user kernel.  Trials run non-donating steps over the
     domain's live buffers (the domain state is never advanced), so the
     tuned plan feeds the very next ``make_step(engine="stream")`` on the
     same process via the cache."""
@@ -284,6 +284,10 @@ def autotune_stream(
             # compile two DIFFERENT kernels even under STENCIL_STREAM_ALIAS
             # (the marker stays out of the persisted config: `cand` wins)
             plan["alias_forced"] = True
+        if "overlap" in plan:
+            # same for the overlap A/B under STENCIL_STREAM_OVERLAP: the
+            # off and split candidates must build their own schedules
+            plan["overlap_forced"] = True
         step = _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=False)
 
         def run(n):
@@ -294,6 +298,7 @@ def autotune_stream(
 
     static = dict(static_plan)
     static.setdefault("halo_multiplier", static.get("m", 1))
+    static.setdefault("overlap", "off")
     return tune.ensure(
         key,
         candidates,

@@ -11,6 +11,12 @@ Axes (ISSUE: the constants PERF_NOTES.md says to re-qualify per chip):
   pipeline is VPU-bound there); a faster-VPU generation flips it.
 * **stream route** (wrap/plane/wavefront) and grouping — the generic
   engine's plan axes.
+* **overlap** (off/split) — the stream engine's split-step schedule
+  (ops/stream.py ``STREAM_OVERLAP``): dispatch the interior pass with no
+  ppermute dependency and recompute the boundary bands afterward, so the
+  collectives hide behind the VPU work at the cost of ~``6·3w``-wide band
+  recomputes; ``off`` is the static fallback, and the win flips with the
+  exchange/compute cost ratio — measured, not assumed.
 * **exchange route** (direct/zpack_xla/zpack_pallas) — the halo exchange's
   z-sweep implementation: the sliced thin-z sliver vs the packed lane-major
   z-shell message (ops/exchange.py EXCHANGE_ROUTES); ``direct`` is the
@@ -175,17 +181,28 @@ def exchange_space(dd) -> Tuple[List[dict], int]:
 
 def stream_space(dd, x_radius: int, separable: bool, static_plan: dict) -> Tuple[List[dict], int]:
     """(candidates, prefiltered) of full stream-engine plans around the
-    static pick: the static plan, its shallower depths, the alias flip, and
-    the plane route as the m=1 structural baseline.  Every candidate is a
-    plan dict ``_build_stream_step`` accepts verbatim (+ ``alias``)."""
-    from stencil_tpu.ops.stream import plan_stream
+    static pick: the static plan, its shallower depths, the alias flip, the
+    plane route as the m=1 structural baseline, and the split-step overlap
+    A/B (``overlap ∈ {off, split}``, ops/stream.py — the interior pass
+    dispatched with no ppermute dependency).  Every candidate is a plan dict
+    ``_build_stream_step`` accepts verbatim (+ ``alias``/``overlap``).
+
+    Every candidate carries an explicit ``overlap`` field ("off" unless it
+    IS the split twin) so persisted winners record the axis — while v2-era
+    entries WITHOUT the field stay consultable (absent = the static off,
+    ops/stream.py ``_overlap_request``); no cache schema bump.  The split
+    twin of a z-slab wavefront re-plans to the plain form
+    (``plain_wavefront_plan``): split needs z halos in the big array for
+    the exchange it overlaps."""
+    from stencil_tpu.ops.stream import plain_wavefront_plan, plan_stream
 
     cands: List[dict] = []
 
-    def add(plan: dict, alias: Optional[bool]) -> None:
+    def add(plan: dict, alias: Optional[bool], overlap: str = "off") -> None:
         c = dict(plan)
         if alias is not None:
             c["alias"] = alias
+        c["overlap"] = overlap
         c.setdefault("halo_multiplier", c.get("m", 1))
         if c not in cands:
             cands.append(c)
@@ -208,4 +225,22 @@ def stream_space(dd, x_radius: int, separable: bool, static_plan: dict) -> Tuple
             add(plan_stream(dd, x_radius, "plane", separable), None)
         except ValueError:
             pass
+    # the overlap A/B: a split twin of the static plan (via the plain-form
+    # re-plan when the static pick is a z-slab wavefront), plus a split twin
+    # of the plane baseline when one made the space — both measured against
+    # their off siblings under the same protocol
+    split_bases: List[Tuple[dict, Optional[bool]]] = []
+    if static_plan["route"] in ("plane", "wavefront"):
+        base = static_plan
+        if static_plan.get("z_slabs"):
+            base = plain_wavefront_plan(dd, static_plan)
+        if base is not None:
+            split_bases.append((base, static_alias))
+    for c in cands:
+        if c["route"] == "plane" and c["overlap"] == "off":
+            split_bases.append((c, c.get("alias")))
+            break
+    for base, alias_pick in split_bases:
+        b = {k: v for k, v in base.items() if k not in ("overlap", "halo_multiplier")}
+        add(b, alias_pick, overlap="split")
     return cands, 0

@@ -46,6 +46,89 @@ def test_strong(capsys):
     assert row[2:5] == ["16", "16", "16"]  # NOT weak-scaled
 
 
+def _overlap_doc(capsys, main, argv):
+    import json
+
+    assert main(argv) == 0
+    return json.loads(_capture(capsys)[-1])
+
+
+def test_weak_overlap_ab(capsys, tmp_path):
+    """``weak --overlap``: the per-mesh overlap A/B JSON artifact (the
+    weak-scaling rows scripts/run_weak_scaling.py collects) — dryrun-capable
+    on the fake CPU mesh, schema pinned here."""
+    import json
+
+    from stencil_tpu.bin.weak import main
+
+    path = tmp_path / "weak_221.json"
+    doc = _overlap_doc(
+        capsys,
+        main,
+        ["12", "12", "12", "1", "--overlap", "--mesh", "2,2,1",
+         "--ab-reps", "1", "--json", str(path)],
+    )
+    assert doc["bench"] == "weak_overlap" and doc["dryrun"] is True
+    assert doc["mesh"] == [2, 2, 1] and doc["chips"] == 4
+    # per-axis weak scaling: 12^3/chip stays exact on the non-cubic mesh
+    assert doc["global"] == [24, 24, 12]
+    assert doc["cells_per_chip"] == 12 * 12 * 12
+    assert doc["measurement_protocol"]["drop_rep0"] is True
+    assert doc["measurement_protocol"]["alternating_within_process"] is True
+    for ov in ("off", "split"):
+        assert doc["overlap"][ov]["mcells_per_s"] > 0
+        assert doc["plans"][ov]["overlap"] == ov
+    assert doc["split_speedup"] > 0
+    assert doc["exchange"]["ms_per_exchange"] > 0
+    assert json.loads(path.read_text()) == doc
+
+
+def test_strong_overlap_ab(capsys):
+    from stencil_tpu.bin.strong import main
+
+    doc = _overlap_doc(
+        capsys,
+        main,
+        ["16", "16", "16", "1", "--overlap", "--mesh", "2,1,1", "--ab-reps", "1"],
+    )
+    assert doc["bench"] == "strong_overlap"
+    assert doc["mesh"] == [2, 1, 1] and doc["global"] == [16, 16, 16]
+
+
+@pytest.mark.slow  # tier-2: spawns one fresh interpreter per mesh shape
+def test_run_weak_scaling_sweep(tmp_path):
+    """scripts/run_weak_scaling.py --dryrun: one artifact per mesh plus the
+    sweep summary with per-chip throughput and weak efficiency."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = Path(__file__).resolve().parents[1] / "scripts" / "run_weak_scaling.py"
+    out = tmp_path / "sweep"
+    proc = subprocess.run(
+        [
+            sys.executable, str(script), "--dryrun", "--iters", "1",
+            "--ab-reps", "1", "--out-dir", str(out),
+            "--meshes", "2,1,1", "2,2,1",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads((out / "weak_scaling_summary.json").read_text())
+    assert summary["bench"] == "weak_scaling_sweep" and summary["dryrun"]
+    assert [m["mesh"] for m in summary["meshes"]] == [[2, 1, 1], [2, 2, 1]]
+    for m in summary["meshes"]:
+        assert m["mcells_per_s_per_chip"]["off"] > 0
+        assert m["mcells_per_s_per_chip"]["split"] > 0
+        assert m["exchange_ms"] > 0
+        assert m["weak_efficiency"]["off"] is not None
+    per_mesh = json.loads((out / "weak_2x1x1.json").read_text())
+    assert per_mesh["bench"] == "weak_overlap" and per_mesh["chips"] == 2
+
+
 def test_weak_exchange(capsys):
     from stencil_tpu.bin.weak_exchange import main
 

@@ -13,6 +13,7 @@ hand-rolled sender/recver state machines.
 """
 
 import re
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -24,10 +25,25 @@ from stencil_tpu.core.radius import Radius
 from stencil_tpu.domain import DistributedDomain
 from stencil_tpu.parallel.mesh import MESH_AXES
 
+# Mosaic lowering of the split-step macro (interior pass + six band passes
+# in one fori_loop body) recurses deeper than CPython's default 1000 frames
+# once pytest's own stack is underneath it; the overflow surfaces as a
+# nonsense "RecursionError in __instancecheck__" LoweringException on a
+# scalar convert.  The same build compiles fine from a bare interpreter.
+if sys.getrecursionlimit() < 10_000:
+    sys.setrecursionlimit(10_000)
+
 
 def _topology_devices():
+    import os
+
     from jax.experimental import topologies
 
+    # Device-less AOT needs no instance metadata, but libtpu still burns
+    # ~7 minutes retrying the GCP metadata server (30 tries x 7 variables)
+    # before giving up — the bulk of this module's measured 481s/test.
+    # Skipping the query turns each AOT compile into seconds.
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
     try:
         topo = topologies.get_topology_desc(
             topology_name="v5e:2x2x1", platform="tpu"
@@ -102,6 +118,76 @@ def test_overlapped_step_schedule_straddles_interior():
     assert starts and dones, (len(starts), len(dones))
     # the straddle: at least one permute is in flight across the interior
     # fusion — its start scheduled before, its done after
+    assert min(starts) < i0, (min(starts), i0)
+    assert max(dones) > i0, (max(dones), i0)
+
+
+@pytest.mark.slow  # tier-2 with its siblings: one more real-TPU-compiler AOT
+# compile (Mosaic kernels included) against the device-less topology
+def test_stream_split_step_schedule_straddles_interior():
+    """The STREAM engine's split-step schedule (ops/stream.py overlap=split)
+    under the real TPU compiler: the scheduled HLO must issue
+    ``collective-permute-start`` BEFORE the interior stream pass (the
+    tpu_custom_call carrying the ``step.overlap.interior`` scope) and the
+    matching ``-done`` after it — the latency-hiding scheduler flies the
+    packed shell messages behind the m-level pallas pass, which the tier-1
+    jaxpr proof (tests/test_overlap_structural.py) shows is legal by
+    dataflow."""
+    from stencil_tpu.ops import stream as sm
+
+    devices = _topology_devices()
+    # conftest enables x64 for the numerical tiers, but Mosaic's lowering of
+    # pallas scratch-ref indexing under x64 loops forever on the resulting
+    # i64->i32 scalar convert (a pallas/x64 toolchain limitation, not a
+    # schedule property) — the proof is about SCHEDULING of f32 kernels, so
+    # trace it with the default 32-bit index widths every driver runs with.
+    x64_was = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    try:
+        dd = DistributedDomain(256, 256, 128)
+        dd.set_radius(Radius.constant(1))
+        dd.set_halo_multiplier(2)
+        dd.add_data("q", dtype=jnp.float32)
+        dd.set_devices(devices)
+        dd.realize(allocate=False)
+        assert dd.num_subdomains() == 4
+
+        def kernel(views, info):
+            return _jacobi_kernel(views, info)
+
+        plan = {
+            "route": "wavefront", "m": 2, "z_slabs": False,
+            "grouping": "joint", "overlap": "split", "overlap_forced": True,
+        }
+        step = sm._build_stream_step(dd, kernel, 1, plan, interpret=False,
+                                     donate=False)
+        text = step.lower(dd.abstract_arrays(), 1).compile().as_text()
+    finally:
+        jax.config.update("jax_enable_x64", x64_was)
+    assert "is_scheduled=true" in text
+
+    lines = text.splitlines()
+    interior = [
+        i
+        for i, l in enumerate(lines)
+        if "step.overlap.interior" in l and "custom-call" in l and "=" in l
+    ]
+    assert interior, "no interior stream custom-call in scheduled module"
+    i0 = interior[0]
+    lo, hi = _computation_block(lines, i0)
+    starts = [
+        i
+        for i in range(lo, hi)
+        if re.search(r"=.*collective-permute-start\(", lines[i])
+    ]
+    dones = [
+        i
+        for i in range(lo, hi)
+        if re.search(r"=.*collective-permute-done\(", lines[i])
+    ]
+    assert starts and dones, (len(starts), len(dones))
+    # the straddle: at least one packed shell permute is in flight across
+    # the interior stream pass
     assert min(starts) < i0, (min(starts), i0)
     assert max(dones) > i0, (max(dones), i0)
 

@@ -97,6 +97,76 @@ def measured_copy_gbps(rt: float, n: int = 514, steps: int = 50) -> float:
     return 2 * a.size * 4 / best / 1e9
 
 
+def mxu_vs_vpu_ab(size: int, k: int, interpret: bool, rt: float,
+                  reps: int = 3, inner: int = None) -> dict:
+    """Steady-state compute-unit A/B on the headline wrap workload: the
+    SAME k-level kernel under ``vpu`` (roll+add chain) and ``mxu`` (banded
+    contraction, ops/jacobi_pallas ``band_matrix``), alternating in ONE
+    process under the trial protocol (rep-0 drop, steady-state median) —
+    the ``route_ab`` shape from the exchange bench, applied to the "Break
+    the VPU wall" lever so the win/loss lands in the BENCH artifact next
+    to the headline it would move.  Returns the JSON section."""
+    import statistics as _stats
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from stencil_tpu.ops.jacobi_pallas import jacobi_wrap_step, mxu_supported
+    from stencil_tpu.tune.trial import measure_alternating
+
+    cells = float(size) ** 3
+    section = {
+        "eligible": bool(mxu_supported([jnp.float32])),
+        "k": k,
+        "measurement_protocol": {
+            "alternating": True, "drop_rep0": True, "stat": "median",
+        },
+        "units": {},
+        "speedup_vs_vpu": None,
+    }
+    units = ["vpu"] + (["mxu"] if section["eligible"] else [])
+    block = jnp.full((size, size, size), 0.5, jnp.float32)
+
+    def make_run(unit):
+        @partial(jax.jit, static_argnums=1)
+        def steps(b, n):
+            return lax.fori_loop(
+                0, n,
+                lambda _, bb: jacobi_wrap_step(
+                    bb, interpret=interpret, k=k, compute_unit=unit
+                ),
+                b,
+            )
+
+        def run(n):
+            steps(block, n).block_until_ready()
+
+        return run
+
+    if inner is None:
+        inner = 25 if size >= 256 else 2
+    runs = [make_run(u) for u in units]
+    inners = [inner] * len(runs)
+    for run, n in zip(runs, inners):
+        run(n)  # warm + compile at the timed count
+    rounds = measure_alternating(runs, inners, rt, reps)
+    for unit, per_rep in zip(units, rounds):
+        dt = _stats.median(per_rep)  # seconds per k-level dispatch
+        section["units"][unit] = {
+            "ms_per_dispatch": round(dt * 1e3, 3),
+            "mcells_per_s": round(cells * k / dt / 1e6, 1),
+        }
+    if "mxu" in section["units"]:
+        section["speedup_vs_vpu"] = round(
+            section["units"]["vpu"]["ms_per_dispatch"]
+            / max(section["units"]["mxu"]["ms_per_dispatch"], 1e-12),
+            3,
+        )
+    return section
+
+
 def main() -> None:
     import statistics as _stats
 
@@ -211,7 +281,19 @@ def main() -> None:
 
     # free the jacobi models' HBM before the 8-field astaroth run (~6 GB)
     wrap_k = model._wrap_k
+    headline_unit = model._compute_unit
+    headline_storage = model.dd.storage_dtype()
     del model, ex_model
+
+    # the compute-unit A/B on the headline workload ("Break the VPU wall"):
+    # failures must never cost the headline fields — record null, keep going
+    mxu_ab = None
+    try:
+        mxu_ab = mxu_vs_vpu_ab(size, wrap_k, interpret, rt,
+                               reps=3 if full else 1)
+    except Exception as e:  # noqa: BLE001 — an A/B accelerator, not a dep
+        print(f"mxu_vs_vpu section failed (recorded null): {e!r}",
+              file=sys.stderr)
 
     # copy bandwidth BEFORE the astaroth section: it feeds the headline
     # roofline fields, which must be complete even if astaroth fails
@@ -232,6 +314,12 @@ def main() -> None:
         # pushes this past 1.0
         "frac_of_chip_roofline": round(mcells_per_s / chip_roofline_mcells, 3),
         "temporal_k": wrap_k,
+        # the headline model's RESOLVED kernel axes (docs/tuning.md
+        # "Compute unit and storage dtype") and the steady-state
+        # compute-unit A/B at the headline depth (route_ab's shape)
+        "compute_unit": headline_unit,
+        "storage_dtype": headline_storage,
+        "mxu_vs_vpu": mxu_ab,
         # the autotuner's decision for this workload: cache hit/miss, trials
         # run (0 on a warm cache), pruned candidates, the winning config,
         # and the search's steady-state numbers for winner vs static

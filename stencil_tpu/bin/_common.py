@@ -125,6 +125,44 @@ def apply_exchange_route(args, dd) -> None:
         dd.set_exchange_route(route)
 
 
+def add_kernel_axis_flags(p: argparse.ArgumentParser) -> None:
+    """``--compute-unit`` / ``--storage-dtype``: pin the level kernels'
+    execution unit and the field buffers' storage dtype for this run
+    (docs/tuning.md "Compute unit and storage dtype").  ``auto`` (default)
+    keeps the planner resolution: ``STENCIL_COMPUTE_UNIT`` /
+    ``STENCIL_STORAGE_DTYPE`` > tuned config > the static ``vpu`` /
+    ``native`` fallbacks; structural guards (non-f32 fields, routes with no
+    contraction/f32-accumulate kernels) degrade with a warning."""
+    p.add_argument(
+        "--compute-unit",
+        default="auto",
+        choices=("auto", "vpu", "mxu"),
+        help="level-kernel execution unit: vpu roll+add chain vs one banded "
+        "contraction per axis on the MXU (auto = env > tuned config > vpu)",
+    )
+    p.add_argument(
+        "--storage-dtype",
+        default="auto",
+        choices=("auto", "native", "bf16"),
+        help="field-buffer storage: native dtype vs bf16 storage with f32 "
+        "accumulation in-kernel — half the bytes/cell (auto = env > tuned "
+        "config > native)",
+    )
+
+
+def kernel_axis_kwargs(args) -> dict:
+    """Model ctor kwargs from ``add_kernel_axis_flags``'s choices (``auto``
+    maps to None = consult the resolution chain)."""
+    out = {}
+    cu = getattr(args, "compute_unit", "auto")
+    sd = getattr(args, "storage_dtype", "auto")
+    if cu != "auto":
+        out["compute_unit"] = cu
+    if sd != "auto":
+        out["storage_dtype"] = sd
+    return out
+
+
 def add_stream_overlap_flag(p: argparse.ArgumentParser) -> None:
     """``--stream-overlap``: pin the stream engine's split-step overlap
     schedule for this run (docs/tuning.md "Stream overlap").  ``auto``

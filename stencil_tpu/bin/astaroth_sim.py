@@ -58,6 +58,7 @@ def main(argv=None) -> int:
     _common.add_telemetry_flags(p)
     _common.add_tune_flags(p)
     _common.add_stream_overlap_flag(p)
+    _common.add_kernel_axis_flags(p)
     args = p.parse_args(argv)
     _common.telemetry_begin(args)
     _common.tune_begin(args)
@@ -110,6 +111,7 @@ def _run(args) -> int:
             report = tune_runners.autotune_stream(
                 tuner_sim.dd, tuner_sim._kernel, x_radius=1, separable=True,
                 interpret=jax.default_backend() == "cpu",
+                mxu_kernel=tuner_sim._kernel_mxu,
             )
             _common.tune_report_stderr(report)
         del tuner_sim
@@ -124,6 +126,7 @@ def _run(args) -> int:
         interpret=jax.default_backend() == "cpu",
         schedule=args.schedule,
         stream_overlap=args.stream_overlap,
+        **_common.kernel_axis_kwargs(args),
     )
     sim.realize()
     sim.step()  # compile

@@ -64,6 +64,7 @@ def main(argv=None) -> int:
     _common.add_telemetry_flags(p)
     _common.add_tune_flags(p)
     _common.add_exchange_route_flag(p)
+    _common.add_kernel_axis_flags(p)
     p.add_argument("x", type=int, nargs="?", default=512)
     p.add_argument("y", type=int, nargs="?", default=512)
     p.add_argument("z", type=int, nargs="?", default=512)
@@ -173,6 +174,7 @@ def _run(args) -> int:
         interpret=jax.default_backend() == "cpu",
         pallas_path=args.pallas_path,
         dtype=jnp.dtype(args.dtype),
+        **_common.kernel_axis_kwargs(args),
     )
     if args.halo_multiplier > 1:
         model.dd.set_halo_multiplier(args.halo_multiplier)

@@ -182,6 +182,12 @@ class DistributedDomain:
         # config > static "direct"; packed-route analytic accounting rides it
         self._exchange_route_req: Optional[str] = None
         self._exchange_route = "direct"
+        # storage-dtype axis (ops/jacobi_pallas STORAGE_DTYPES): models
+        # resolve the axis (explicit > STENCIL_STORAGE_DTYPE > tuned >
+        # static native) and pin the RESOLVED value here before realize();
+        # field allocation, exchange byte accounting, and the packed z-shell
+        # messages all follow ``field_dtype``
+        self._storage = "native"
         self._packed_nbytes = 0
         self._packed_nkernels = 0
         self._halo_mult = 1
@@ -282,6 +288,41 @@ class DistributedDomain:
         """The resolved z-sweep route (meaningful after ``realize()``)."""
         return self._exchange_route
 
+    def set_storage(self, storage: str) -> None:
+        """Pin the field buffers' STORAGE dtype axis (``"native"`` |
+        ``"bf16"`` — ops/jacobi_pallas ``STORAGE_DTYPES``).  Callers (the
+        models' ctor knobs) resolve the axis through
+        ``resolve_storage_dtype`` — precedence explicit >
+        ``STENCIL_STORAGE_DTYPE`` > tuned config > static ``native``, with
+        the structural f32-only / f32-accumulate-engine gates — and hand
+        the RESOLVED value here before ``realize()``.  Under ``bf16`` every
+        f32 field allocates as bfloat16 (HBM planes, the VMEM pipeline
+        blocks streamed from them, and the fused exchange messages all
+        narrow to 2 B/cell); the kernels accumulate at f32 and downcast
+        once per pass (the ``f32_accumulate`` contract), and host readback
+        (``quantity_to_host`` etc.) upcasts back to the native dtype."""
+        from stencil_tpu.ops.jacobi_pallas import STORAGE_DTYPES
+
+        if storage not in STORAGE_DTYPES:
+            raise ValueError(
+                f"unknown storage dtype {storage!r} (one of {STORAGE_DTYPES})"
+            )
+        assert not self._realized, "set_storage must precede realize()"
+        self._storage = storage
+
+    def storage_dtype(self) -> str:
+        """The resolved storage axis: ``"native"`` or ``"bf16"``."""
+        return self._storage
+
+    def field_dtype(self, h: DataHandle):
+        """The dtype ``h``'s buffers actually store: bfloat16 under the
+        bf16 storage axis for f32 fields (the only narrowing the analytic
+        error contract covers — see ``bf16_supported``), the native dtype
+        otherwise."""
+        if self._storage == "bf16" and jnp.dtype(h.dtype) == jnp.float32:
+            return jnp.dtype(jnp.bfloat16)
+        return h.dtype
+
     def tune_key(self, route: str):
         """The autotuner ``WorkloadKey`` for this domain under ``route`` —
         THE one place the (chip kind, domain shape, dtype, n_fields, mesh
@@ -338,6 +379,22 @@ class DistributedDomain:
         then be lowered/compiled against abstract sharded shapes (used by the
         overlap-schedule proof, tests/test_overlap_schedule.py)."""
         self._radius.validate()
+        if self._storage == "bf16":
+            # the structural gate the model resolvers apply, repeated here
+            # for direct set_storage() callers: the f32-accumulate stream
+            # passes upcast EVERY quantity uniformly, so a mixed domain with
+            # non-f32 fields (f64 would silently lose 29 mantissa bits, int
+            # fields have no f32 round trip contract) must degrade the whole
+            # axis — only all-f32 domains narrow (``bf16_supported``)
+            from stencil_tpu.ops.jacobi_pallas import bf16_supported
+
+            if not bf16_supported([h.dtype for h in self._handles]):
+                log_warn(
+                    "storage bf16 cannot engage: fields are "
+                    f"{[jnp.dtype(h.dtype).name for h in self._handles]}, "
+                    "not all f32; degrading to native storage"
+                )
+                self._storage = "native"
         t0 = time.perf_counter()
         devices = list(self._devices) if self._devices is not None else jax.devices()
         self.stats.time_topo = time.perf_counter() - t0
@@ -390,8 +447,9 @@ class DistributedDomain:
         t0 = time.perf_counter()
         for h in self._handles:
             hsharding = NamedSharding(self.mesh, _qspec(h))
-            self._curr[h.name] = jnp.zeros(h.components + gshape, dtype=h.dtype, device=hsharding)
-            self._next[h.name] = jnp.zeros(h.components + gshape, dtype=h.dtype, device=hsharding)
+            fdt = self.field_dtype(h)
+            self._curr[h.name] = jnp.zeros(h.components + gshape, dtype=fdt, device=hsharding)
+            self._next[h.name] = jnp.zeros(h.components + gshape, dtype=fdt, device=hsharding)
         self.stats.time_realize = time.perf_counter() - t0
         t0 = time.perf_counter()
         if self._methods in (MethodFlags.AllGather, MethodFlags.RollCompare):
@@ -489,7 +547,7 @@ class DistributedDomain:
         if route is None:
             route = "direct"
         if route != "direct" and not zpack_supported(
-            [h.dtype for h in self._handles], self._valid_last
+            [self.field_dtype(h) for h in self._handles], self._valid_last
         ):
             log_warn(
                 f"exchange route {route!r} ({source}) cannot engage here "
@@ -570,7 +628,7 @@ class DistributedDomain:
         return {
             h.name: jax.ShapeDtypeStruct(
                 h.components + gshape,
-                h.dtype,
+                self.field_dtype(h),
                 sharding=NamedSharding(self.mesh, _qspec(h)),
             )
             for h in self._handles
@@ -664,7 +722,7 @@ class DistributedDomain:
         quantity's interior."""
         want = h.components + tuple(self._size)
         assert interior.shape == want, (interior.shape, want)
-        raw = self._to_raw_global(np.asarray(interior), h.dtype)
+        raw = self._to_raw_global(np.asarray(interior), self.field_dtype(h))
         sharding = NamedSharding(self.mesh, _qspec(h))
         arr = jax.device_put(jnp.asarray(raw), sharding)
         (self._curr if slot == "curr" else self._next)[h.name] = arr
@@ -673,7 +731,11 @@ class DistributedDomain:
         """Gather a quantity's interior to a (X,Y,Z) host array (analog of
         reference quantity_to_host, local_domain.cuh:329-346)."""
         arr = (self._curr if slot == "curr" else self._next)[h.name]
-        return self._from_raw_global(np.asarray(jax.device_get(arr)))
+        # bf16-storage buffers upcast back to the native dtype at readback
+        # (exact: every bfloat16 is an f32)
+        return self._from_raw_global(np.asarray(jax.device_get(arr))).astype(
+            h.dtype, copy=False
+        )
 
     def region_to_host(self, h: DataHandle, region: Rect3, slot: str = "curr") -> np.ndarray:
         """Arbitrary-region readback in USER-domain (global) coordinates —
@@ -713,7 +775,9 @@ class DistributedDomain:
                         olo.x - r.lo.x : ohi.x - r.lo.x,
                         olo.y - r.lo.y : ohi.y - r.lo.y,
                         olo.z - r.lo.z : ohi.z - r.lo.z,
-                    ] = np.asarray(jax.device_get(block))
+                    ] = np.asarray(jax.device_get(block)).astype(
+                        h.dtype, copy=False
+                    )
         return out
 
     def interior_to_host(self, h: DataHandle, slot: str = "curr") -> np.ndarray:
@@ -742,7 +806,7 @@ class DistributedDomain:
             self._curr = self._exchange_fn(self._curr)
             self._shell_stale = False
         arr = (self._curr if slot == "curr" else self._next)[h.name]
-        return np.asarray(jax.device_get(arr))
+        return np.asarray(jax.device_get(arr)).astype(h.dtype, copy=False)
 
     def init_by_coords(self, h: DataHandle, fn, include_halo: bool = False) -> None:
         """Device-side init: ``fn(cx, cy, cz)`` maps broadcastable global
@@ -824,7 +888,7 @@ class DistributedDomain:
                 raw = self._spec.raw_size()
                 shell = self._shell_radius
                 itemsizes = [
-                    h.dtype.itemsize
+                    self.field_dtype(h).itemsize
                     for h in self._handles
                     for _ in range(h.cell_count())
                 ]
@@ -914,7 +978,10 @@ class DistributedDomain:
 
         per_dom = exchange_bytes(
             self._spec,
-            [h.dtype.itemsize * h.cell_count() for h in self._handles],
+            [
+                self.field_dtype(h).itemsize * h.cell_count()
+                for h in self._handles
+            ],
         )
         return per_dom * self.num_subdomains()
 
@@ -937,7 +1004,10 @@ class DistributedDomain:
 
         lines = [self.placement.report(), "", "# messages (method=ppermute for all)"]
         spec = self._spec
-        itemsizes = [h.dtype.itemsize * h.cell_count() for h in self._handles]
+        itemsizes = [
+            self.field_dtype(h).itemsize * h.cell_count()
+            for h in self._handles
+        ]
         for d in DIRECTIONS_26:
             if spec.radius.dir(-d) == 0:
                 continue
@@ -986,6 +1056,15 @@ class DistributedDomain:
         # interior pass with no data dependency on the shell ppermutes and
         # recomputes the boundary bands from fresh halos afterward —
         # bitwise-identical to "off"; "auto" resolves env > tuned > off
+        compute_unit: str = "auto",  # stream engine: the level kernels'
+        # execution unit (ops/jacobi_pallas COMPUTE_UNITS): "mxu" routes
+        # the separable in-plane taps through banded contractions on the
+        # matrix unit — needs `mxu_kernel`; "auto" resolves env > tuned >
+        # the static vpu (docs/tuning.md "Compute unit and storage dtype")
+        mxu_kernel=None,  # stream engine: the kernel's DECLARED
+        # axis-separable contraction form, written against
+        # PlaneView.plane_nbr_sum (≤1 ulp/level vs `kernel`); None =
+        # no mxu form, compute_unit=mxu structurally degrades to vpu
         interpret: bool = False,  # stream engine only: pallas interpret mode
     ):
         """Build ``step(curr) -> next`` fusing exchange + compute.
@@ -1035,9 +1114,23 @@ class DistributedDomain:
                 self, kernel, x_radius=x_radius, path=stream_path,
                 separable=separable, interpret=interpret, donate=donate,
                 max_depth=stream_depth, overlap=stream_overlap,
+                compute_unit=compute_unit, mxu_kernel=mxu_kernel,
             )
         if engine != "xla":
             raise ValueError(f"unknown engine {engine!r}")
+        if compute_unit not in (None, "auto"):
+            # the XLA slice engine has no pallas level kernels — resolve
+            # through the shared chain so an explicit mxu request degrades
+            # with the standard warning + kernel.compute_unit event instead
+            # of being silently dropped (env/tuned stay un-consulted here:
+            # there is no unit to switch)
+            from stencil_tpu.ops.jacobi_pallas import resolve_compute_unit
+
+            resolve_compute_unit(
+                compute_unit, None, [h.dtype for h in self._handles],
+                where="xla", engine_ok=False,
+                engine_why="the XLA slice engine has no pallas level kernels",
+            )
         from stencil_tpu.core.geometry import exterior_of, shrink_by_radius
 
         n = self._spec.sz
@@ -1164,7 +1257,7 @@ class DistributedDomain:
         from stencil_tpu.ops.exchange import route_vma_check
 
         check_vma = route_vma_check(
-            [h.dtype for h in self._handles],
+            [self.field_dtype(h) for h in self._handles],
             self._valid_last,
             max((len(h.components) for h in self._handles), default=0),
             self._exchange_route,

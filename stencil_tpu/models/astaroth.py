@@ -68,6 +68,15 @@ class AstarothSim:
         stream_overlap: str = "auto",  # pallas engine only: the stream
         # engine's split-step overlap schedule (ops/stream.py
         # STREAM_OVERLAP; "auto" = env > tuned > static off)
+        compute_unit: str = "auto",  # pallas engine only: the level
+        # kernels' execution unit ("vpu" | "mxu" | "auto" = env > tuned >
+        # static vpu).  mxu runs ``_kernel_mxu`` — the same mean-of-6
+        # written through the views' banded-contraction seam
+        # (PlaneView.plane_nbr_sum; ≤1 ulp/level vs vpu)
+        storage_dtype: str = None,  # field buffers' storage axis ("native"
+        # | "bf16" | None/"auto" = env > tuned > static native): bf16
+        # stores f32 fields at 2 B/cell end-to-end while the stream kernels
+        # accumulate at f32; the XLA engine degrades to native
     ):
         self.dd = DistributedDomain(x, y, z)
         self.dd.set_radius(Radius.constant(3))  # astaroth_sim.cu:184
@@ -85,11 +94,36 @@ class AstarothSim:
             raise ValueError(f"unknown schedule {schedule!r}")
         self.schedule = schedule
         self.stream_overlap = stream_overlap
+        self.compute_unit = compute_unit
+        self.storage_dtype_request = storage_dtype
+        self._storage_dtype = "native"
         if check_divergence_every:
             self.dd.set_divergence_check(check_divergence_every)
         self._step = None
 
     def realize(self) -> None:
+        # storage dtype resolves BEFORE allocation (explicit >
+        # STENCIL_STORAGE_DTYPE > tuned "stream" config > static native);
+        # only the pallas (stream) engine has f32-accumulate kernels
+        from stencil_tpu.ops.jacobi_pallas import resolve_storage_dtype
+
+        tuned = None
+        if self.storage_dtype_request in (None, "auto") and self.kernel_impl == "pallas":
+            from stencil_tpu import tune
+
+            cfg = tune.best_config(self.dd.tune_key("stream"))
+            tuned = (cfg or {}).get("storage_dtype")
+        sd, _src = resolve_storage_dtype(
+            self.storage_dtype_request,
+            tuned,
+            [h.dtype for h in self.handles],
+            where="astaroth",
+            engine_ok=self.kernel_impl == "pallas",
+            engine_why="the XLA slice engine has no f32-accumulate kernels",
+        )
+        self._storage_dtype = sd
+        if sd != "native":
+            self.dd.set_storage(sd)
         self.dd.realize()
         w = 2 * math.pi / self.period
         for h in self.handles:
@@ -126,6 +160,10 @@ class AstarothSim:
                 separable=True,
                 interpret=self.interpret,
                 stream_overlap=self.stream_overlap,
+                compute_unit=self.compute_unit,
+                # the declared axis-separable contraction form — what lets
+                # compute_unit=mxu engage on this kernel
+                mxu_kernel=self._kernel_mxu,
             )
         else:
             if self.schedule == "wavefront":
@@ -155,6 +193,20 @@ class AstarothSim:
                 + src.sh(1, 0, 0)
                 + src.sh(0, 1, 0)
                 + src.sh(0, 0, 1)
+            ) / 6.0
+        return out
+
+    def _kernel_mxu(self, views, info):
+        # the SAME mean-of-6 with its four in-plane taps written through the
+        # banded-contraction seam (PlaneView.plane_nbr_sum) — on the MXU
+        # when the engine hands the views band matrices, and ≤1 ulp/level
+        # from `_kernel` either way (the in-plane pair sums regroup); the
+        # x taps stay plane reads.  The vpu `_kernel` above is untouched,
+        # so the default path stays bitwise-identical to pre-axis builds.
+        out = {}
+        for name, src in views.items():
+            out[name] = (
+                src.sh(-1, 0, 0) + src.sh(1, 0, 0) + src.plane_nbr_sum()
             ) / 6.0
         return out
 

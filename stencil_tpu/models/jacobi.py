@@ -54,6 +54,18 @@ class Jacobi3D:
         z_ring: bool = None,  # z-RING vs padded layout preference: None =
         # env (STENCIL_Z_RING) > tuned config > ring default; structural
         # gates (lane alignment, slab mode) still apply either way
+        compute_unit: str = None,  # level kernels' execution unit ("vpu" |
+        # "mxu" | None/"auto"): mxu contracts the in-plane taps against
+        # banded coefficient matrices on the matrix unit (≤1 ulp/level vs
+        # vpu).  None/"auto" = STENCIL_COMPUTE_UNIT > tuned config > static
+        # vpu; structural guards (non-f32 compute, routes with no
+        # contraction kernel) degrade to vpu with a warning
+        storage_dtype: str = None,  # field buffers' storage axis ("native"
+        # | "bf16" | None/"auto"): bf16 stores f32 fields at 2 B/cell
+        # end-to-end (HBM, VMEM pipeline, exchange messages) while the
+        # kernels accumulate at f32 and downcast once per pass.  None/
+        # "auto" = STENCIL_STORAGE_DTYPE > tuned config > static native;
+        # non-f32 fields and the XLA engine degrade to native with a warning
     ):
         self.dd = DistributedDomain(x, y, z)
         # radius 1 on faces only (jacobi3d.cu:205-214)
@@ -74,6 +86,12 @@ class Jacobi3D:
         self.pallas_path_request = pallas_path
         self.wavefront_alias_request = wavefront_alias
         self.z_ring_request = z_ring
+        self.compute_unit_request = compute_unit
+        self.storage_dtype_request = storage_dtype
+        # resolved axes (realize() / the step builders fill these in)
+        self._compute_unit = "vpu"
+        self._storage_dtype = "native"
+        self._mxu_flops_iter = 0  # analytic MXU FLOPs per raw iteration
         if check_divergence_every:
             self.dd.set_divergence_check(check_divergence_every)
         # tuned config applied by _plan_wavefront (auto mode only)
@@ -89,6 +107,10 @@ class Jacobi3D:
 
     def realize(self) -> None:
         self._wavefront_m = 0
+        # storage dtype resolves FIRST: it shapes the allocation and the
+        # VMEM-model itemsizes every later plan (wavefront fits, temporal-k)
+        # consults
+        self._resolve_storage()
         if self.kernel_impl == "pallas" and self.pallas_path_request in ("auto", "wavefront"):
             # must be decided BEFORE dd.realize(): the wavefront path rides
             # the halo-multiplier machinery (m-wide shells, exchange every m
@@ -130,6 +152,48 @@ class Jacobi3D:
 
         devs = self.dd._devices
         return len(devs) if devs is not None else len(jax.devices())
+
+    def _prospective_tune_route(self):
+        """The workload-key route the build WILL consult (pre-realize
+        mirror of the route choice) — where the tuned compute-unit/
+        storage-dtype fields live; None when no tunable pallas route can be
+        reached (jnp engine, forced slab/shell)."""
+        if self.kernel_impl != "pallas":
+            return None
+        req = self.pallas_path_request
+        single = self._planned_devices() == 1
+        if req == "wrap" or (req == "auto" and single):
+            return "jacobi-wrap"
+        if req in ("auto", "wavefront") and not single:
+            return "jacobi-wavefront"
+        return None
+
+    def _resolve_storage(self) -> None:
+        """Resolve the storage-dtype axis (explicit ctor knob >
+        ``STENCIL_STORAGE_DTYPE`` > tuned config > static ``native`` —
+        ops/jacobi_pallas.resolve_storage_dtype) and pin the result on the
+        domain BEFORE allocation.  The XLA engine has no f32-accumulate
+        kernels, so it structurally degrades bf16 to native."""
+        from stencil_tpu.ops.jacobi_pallas import resolve_storage_dtype
+
+        route = self._prospective_tune_route()
+        tuned = None
+        if self.storage_dtype_request in (None, "auto") and route is not None:
+            from stencil_tpu import tune
+
+            cfg = tune.best_config(self.dd.tune_key(route))
+            tuned = (cfg or {}).get("storage_dtype")
+        sd, _src = resolve_storage_dtype(
+            self.storage_dtype_request,
+            tuned,
+            [self.h.dtype],
+            where=f"jacobi:{route or self.kernel_impl}",
+            engine_ok=self.kernel_impl == "pallas",
+            engine_why="the XLA slice engine has no f32-accumulate kernels",
+        )
+        self._storage_dtype = sd
+        if sd != "native":
+            self.dd.set_storage(sd)
 
     def _plan_wavefront(self) -> int:
         """Choose the wavefront depth m (>= 1) before ``dd.realize()``: mirror
@@ -174,7 +238,28 @@ class Jacobi3D:
                 f"over mesh {tuple(dim)}"
             )
         n_min = min(min(n), min(v))
-        itemsize = self.h.dtype.itemsize
+        # pipeline planes stream at the STORAGE itemsize; the level ring
+        # carries the f32_accumulate working precision (native itemsize)
+        itemsize = self.dd.field_dtype(self.h).itemsize
+        ring_itemsize = self.h.dtype.itemsize
+        # PROSPECTIVE compute unit (emit=False — the authoritative
+        # resolution with its telemetry event happens at build time in
+        # _make_wavefront_step): folds the contraction form's resident
+        # band-matrix constants into the depth gate below
+        from stencil_tpu import tune
+        from stencil_tpu.ops.jacobi_pallas import (
+            mxu_supported,
+            resolve_compute_unit,
+        )
+
+        p_mxu = False
+        if mxu_supported([self.h.dtype]):  # else build-time warns once
+            cfg0 = tune.best_config(dd.tune_key("jacobi-wavefront")) or {}
+            p_unit, _ = resolve_compute_unit(
+                self.compute_unit_request, cfg0.get("compute_unit"),
+                [self.h.dtype], where="jacobi-wavefront", emit=False,
+            )
+            p_mxu = p_unit == "mxu"
         # planning diagnostics for the autotuner's candidate-space builder
         # (tune/runners.autotune_jacobi_wavefront)
         self._wavefront_plan_info = {
@@ -183,7 +268,8 @@ class Jacobi3D:
 
         def fits(m, z):
             return wavefront_vmem_fits(
-                m, n[1] + 2 * m, n[2] + 2 * m, itemsize, z_slabs=z
+                m, n[1] + 2 * m, n[2] + 2 * m, itemsize, z_slabs=z,
+                ring_itemsize=ring_itemsize, mxu=p_mxu,
             )
 
         if self.temporal_k != "auto":
@@ -192,7 +278,8 @@ class Jacobi3D:
                 raise ValueError(
                     f"wavefront temporal_k={m} needs 1 <= m <= min(shard/valid)={n_min}"
                 )
-            warn_if_over_vmem_budget(m, n[1] + 2 * m, n[2] + 2 * m, itemsize)
+            warn_if_over_vmem_budget(m, n[1] + 2 * m, n[2] + 2 * m, itemsize,
+                                     ring_itemsize, mxu=p_mxu)
             self._wavefront_z_planned = fits(m, True) and not padded
             return m
         # the autotuner's persisted on-device measurement beats the static
@@ -287,6 +374,22 @@ class Jacobi3D:
         from stencil_tpu.utils.config import env_bool
 
         tuned = self._tuned_wavefront or {}
+        # compute-unit axis: explicit ctor knob > STENCIL_COMPUTE_UNIT >
+        # tuned config > static vpu; non-f32 compute dtypes degrade
+        from stencil_tpu.ops.jacobi_pallas import (
+            mxu_flops_per_plane,
+            resolve_compute_unit,
+        )
+
+        unit, _unit_src = resolve_compute_unit(
+            self.compute_unit_request,
+            tuned.get("compute_unit"),
+            [self.h.dtype],
+            where="jacobi-wavefront",
+        )
+        self._compute_unit = unit
+        f32_acc = dd.field_dtype(self.h) != self.h.dtype
+        kern_kw = {"compute_unit": unit, "f32_accumulate": f32_acc}
         z_slab_mode = env_bool("STENCIL_Z_SLABS", True) and getattr(
             self, "_wavefront_z_planned", False
         )
@@ -346,6 +449,14 @@ class Jacobi3D:
         # (z_valid).  Padding/unpadding happens once per step() dispatch,
         # amortized over the device-side macro loop.
         Zp = lane_pad_width(Zr) if z_slab_mode else Zr
+        # analytic MXU FLOPs per raw iteration (all shards): one band
+        # contraction pair per streamed plane per level — the
+        # kernel.mxu.flops counter's per-step increment (step())
+        self._mxu_flops_iter = (
+            mxu_flops_per_plane(Yr, Zp) * Xr * dd.num_subdomains()
+            if unit == "mxu"
+            else 0
+        )
 
         def per_shard(steps, raw_block):
             # origin (and everything derived from it, like the d2 planes)
@@ -375,7 +486,7 @@ class Jacobi3D:
                     )
                     return jacobi_shell_wavefront_step(
                         b, depth, origin, yz_d2, gsize, interior_offset=m,
-                        alias=alias, interpret=interpret,
+                        alias=alias, interpret=interpret, **kern_kw,
                     )
 
                 macros, rem = divmod(steps, depth_run)
@@ -408,6 +519,7 @@ class Jacobi3D:
                     return jacobi_zring_wavefront_step(
                         b, depth, origin, ring_d2, gsize, z_slabs=zs,
                         interior_offset=m, alias=alias, interpret=interpret,
+                        **kern_kw,
                     )
 
                 b0 = lax.slice(
@@ -437,6 +549,7 @@ class Jacobi3D:
                 return jacobi_shell_wavefront_step(
                     b, depth, origin, yz_d2, gsize, interior_offset=m,
                     z_slabs=zs, z_valid=Zr, alias=alias, interpret=interpret,
+                    **kern_kw,
                 )
 
             # prime the slab carry from the block's interior z boundaries
@@ -538,11 +651,41 @@ class Jacobi3D:
             interpret = self.interpret
             self._marks_shell_stale = True
             self._pallas_path = "wrap"
+            # pipeline planes stream at the STORAGE itemsize; the level
+            # compute-unit axis: explicit ctor knob > STENCIL_COMPUTE_UNIT >
+            # tuned config > static vpu — resolved BEFORE the depth choice
+            # so the VMEM model can fold in the contraction form's resident
+            # band matrices (choose_temporal_k's mxu= term)
+            from stencil_tpu import tune
+            from stencil_tpu.ops.jacobi_pallas import (
+                mxu_flops_per_plane,
+                resolve_compute_unit,
+            )
+
+            cfg = tune.best_config(dd.tune_key("jacobi-wrap")) or {}
+            unit, _unit_src = resolve_compute_unit(
+                self.compute_unit_request,
+                cfg.get("compute_unit"),
+                [self.h.dtype],
+                where="jacobi-wrap",
+            )
+            self._compute_unit = unit
+            # ring carries the f32_accumulate working precision, so the
+            # VMEM model takes both (a storage-only model under bf16 would
+            # admit depths whose f32 ring blows the budget)
             k = choose_temporal_k(
-                (n.x, n.y, n.z), self.h.dtype.itemsize, self.temporal_k,
+                (n.x, n.y, n.z), dd.field_dtype(self.h).itemsize,
+                self.temporal_k,
                 tune_key=dd.tune_key("jacobi-wrap"),
+                ring_itemsize=self.h.dtype.itemsize,
+                mxu=unit == "mxu",
             )
             self._wrap_k = k
+            f32_acc = dd.field_dtype(self.h) != self.h.dtype
+            kern_kw = {"compute_unit": unit, "f32_accumulate": f32_acc}
+            self._mxu_flops_iter = (
+                mxu_flops_per_plane(n.y, n.z) * n.x if unit == "mxu" else 0
+            )
 
             @partial(jax.jit, static_argnums=1, donate_argnums=0)
             def step(curr, steps: int = 1):
@@ -559,13 +702,17 @@ class Jacobi3D:
                     block = lax.fori_loop(
                         0,
                         blocked,
-                        lambda _, b: jacobi_wrap_step(b, interpret=interpret, k=k),
+                        lambda _, b: jacobi_wrap_step(
+                            b, interpret=interpret, k=k, **kern_kw
+                        ),
                         block,
                     )
                 if rem:
                     # one k=rem wavefront (rem < k <= X//2 so always valid);
                     # bit-exact and one HBM pass instead of rem
-                    block = jacobi_wrap_step(block, interpret=interpret, k=rem)
+                    block = jacobi_wrap_step(
+                        block, interpret=interpret, k=rem, **kern_kw
+                    )
                 # stencil-lint: disable=sliver-dus whole-interior write-back into the shell-carrying array after the k-loop — block spans the full interior, not a y/z sliver
                 return {name: lax.dynamic_update_slice(arr, block, (lo.x, lo.y, lo.z))}
 
@@ -577,6 +724,7 @@ class Jacobi3D:
         ):
             return self._make_slab_step()
         self._pallas_path = "shell"
+        self._resolve_unit_no_contraction("jacobi-shell")
         n = dd.local_spec().sz
         shell = dd._shell_radius
         mesh_shape = tuple(dd.mesh.shape[a] for a in MESH_AXES)
@@ -584,6 +732,7 @@ class Jacobi3D:
         valid_last = dd._valid_last
         interpret = self.interpret
         name = self.h.name
+        f32_acc = dd.field_dtype(self.h) != self.h.dtype
 
         def per_shard(steps, block):
             shape_yz = (block.shape[1] - 2, block.shape[2] - 2)
@@ -596,7 +745,10 @@ class Jacobi3D:
                 )
                 yz_d2 = yz_dist2_plane(origin[1], origin[2], shape_yz, gsize)
                 b = halo_exchange_shard(b, shell, mesh_shape, valid_last=valid_last)
-                return jacobi_plane_step(b, origin, yz_d2, gsize, interpret=interpret)
+                return jacobi_plane_step(
+                    b, origin, yz_d2, gsize, interpret=interpret,
+                    f32_accumulate=f32_acc,
+                )
 
             return lax.fori_loop(0, steps, body, block)
 
@@ -645,6 +797,8 @@ class Jacobi3D:
         name = self.h.name
         self._marks_shell_stale = True
         self._pallas_path = "slab"
+        self._resolve_unit_no_contraction("jacobi-slab")
+        f32_acc = dd.field_dtype(self.h) != self.h.dtype
 
         def per_shard(steps, raw_block):
             block = lax.slice(
@@ -670,7 +824,7 @@ class Jacobi3D:
                 zhi = _shift_from_high(b[:, :, 0].T, MESH_AXES[2], mesh_shape[2])
                 return jacobi_slab_step(
                     b, xlo, xhi, ylo, yhi, zlo, zhi, origin, yz_d2, gsize,
-                    interpret=interpret,
+                    interpret=interpret, f32_accumulate=f32_acc,
                 )
 
             block = lax.fori_loop(0, steps, body, block)
@@ -692,6 +846,23 @@ class Jacobi3D:
             return {name: fn(curr[name])}
 
         return step
+
+    def _resolve_unit_no_contraction(self, where: str) -> None:
+        """Compute-unit resolution for routes WITHOUT a contraction kernel
+        (slab/shell): any mxu request — explicit, env, or tuned — degrades
+        to vpu with a warning instead of crashing or silently engaging."""
+        from stencil_tpu.ops.jacobi_pallas import resolve_compute_unit
+
+        unit, _src = resolve_compute_unit(
+            self.compute_unit_request,
+            None,
+            [self.h.dtype],
+            where=where,
+            engine_ok=False,
+            engine_why="the slab/shell routes have no contraction kernels",
+        )
+        self._compute_unit = unit
+        self._mxu_flops_iter = 0
 
     def _kernel(self, views, info):
         size = info.global_size
@@ -739,19 +910,30 @@ class Jacobi3D:
                     f"multiplier {mult} on the jnp engine (macro steps)"
                 )
             steps //= mult
+        # analytic, from the plan the run STARTS on (a mid-run ladder
+        # step-down keeps the pre-degrade count for this call)
+        mxu_flops = steps * self._mxu_flops_iter
         self._ladder.step(steps)
+        if mxu_flops:
+            from stencil_tpu import telemetry
+            from stencil_tpu.telemetry import names as tm
+
+            telemetry.inc(tm.KERNEL_MXU_FLOPS, mxu_flops)
         if self._marks_shell_stale:
             self.dd.mark_shell_stale()
 
     def _rung_name(self) -> str:
         if self.kernel_impl != "pallas":
             return "xla"
+        suffix = ",mxu" if self._compute_unit == "mxu" else ""
+        if self.dd.storage_dtype() == "bf16":
+            suffix += ",bf16"
         if self._pallas_path == "wrap":
-            return f"wrap[k={self._wrap_k}]"
+            return f"wrap[k={self._wrap_k}{suffix}]"
         if self._pallas_path == "wavefront":
             depth = getattr(self, "_wavefront_depth", self._wavefront_m)
-            return f"wavefront[depth={depth}]"
-        return self._pallas_path or "pallas"
+            return f"wavefront[depth={depth}{suffix}]"
+        return (self._pallas_path or "pallas") + suffix
 
     def _run_current(self, steps: int = 1) -> None:
         # resolves self._step at CALL time: the degradation ladder swaps the
@@ -790,6 +972,29 @@ class Jacobi3D:
 
         if self.kernel_impl != "pallas":
             return False
+        # the new-axis rungs come BEFORE any depth descent: an mxu or bf16
+        # build carries its own extra compiler surface (band matmuls /
+        # mixed-dtype pipelines), so the failure may be the axis's fault,
+        # not the depth's — step the axis down at the SAME depth first
+        if self._compute_unit == "mxu":
+            log_warn(
+                f"compute_unit=mxu on the {self._pallas_path} route exceeded "
+                f"the compiler's capability ({cls.value}); stepping down to "
+                "vpu at the same depth"
+            )
+            self.compute_unit_request = "vpu"  # forced for the rebuild
+            self._rebuild_current_route()
+            return True
+        if self.dd.storage_dtype() == "bf16":
+            log_warn(
+                f"storage_dtype=bf16 on the {self._pallas_path} route "
+                f"exceeded the compiler's capability ({cls.value}); stepping "
+                "down to native storage at the same depth (exact: every "
+                "bfloat16 value upcasts losslessly)"
+            )
+            self._convert_storage_to_native()
+            self._rebuild_current_route()
+            return True
         if self._pallas_path == "wrap" and self._wrap_k > 1:
             self.temporal_k = self._wrap_k - 1
             log_warn(
@@ -814,6 +1019,40 @@ class Jacobi3D:
             self._step = self._make_wavefront_step()
             return True
         return False
+
+    def _rebuild_current_route(self) -> None:
+        """Rebuild the installed step for the CURRENT route after an axis
+        step-down (mxu->vpu / bf16->native) — same depth, same allocation.
+        The wrap rebuild re-runs ``choose_temporal_k`` (whose auto/tuned
+        resolution could shift under the changed storage itemsize), so pin
+        the depth explicitly: the axis steps down FIRST, depth only through
+        its own later ladder rungs."""
+        if self._pallas_path == "wrap":
+            self.temporal_k = self._wrap_k
+        if self._pallas_path == "wavefront":
+            self._step = self._make_wavefront_step()
+        else:
+            self._step = self._make_pallas_step()
+
+    def _convert_storage_to_native(self) -> None:
+        """Runtime bf16->native step-down: upcast the live field buffers
+        (exact — every bfloat16 is an f32) and re-mark the domain native so
+        rebuilt kernels, the exchange, and the byte accounting all follow.
+        Post-realize by necessity (this is a ladder rung, the allocation
+        already exists), hence the direct ``_storage`` write rather than
+        ``set_storage``'s pre-realize setter."""
+        dd = self.dd
+        dd._storage = "native"
+        self._storage_dtype = "native"
+        for h in dd._handles:
+            for slot in (dd._curr, dd._next):
+                if h.name in slot:
+                    slot[h.name] = slot[h.name].astype(h.dtype)
+        # the analytic exchange-bytes cache and the compiled exchange were
+        # built over the narrow buffers; drop both so they re-derive
+        dd._exchange_nbytes = None
+        dd._packed_nbytes = dd._packed_nkernels = 0
+        dd._exchange_many_fn = None
 
     def temperature(self) -> np.ndarray:
         return self.dd.quantity_to_host(self.h)

@@ -36,6 +36,234 @@ from stencil_tpu.core.dim3 import Dim3
 HOT_TEMP = 1.0
 COLD_TEMP = 0.0
 
+#: compute-unit axis for the streaming level kernels — a first-class tuner
+#: candidate (tune/space.py; docs/tuning.md "Compute unit and storage
+#: dtype"): ``vpu`` = the measured roll+add chain (the static cold-cache
+#: fallback, bitwise-pinned by tier-1), ``mxu`` = the per-axis stencil
+#: application as ONE banded contraction per axis on the matrix unit
+#: (``_band_neighbor_sum``) — the wafer-scale stencil mapping (PAPERS.md
+#: arxiv 2605.07954 / 2601.17754) aimed at the measured VPU wall
+#: (PERF_NOTES "VPU wall": the k≈12-24 plateau is roll+add-bound, not DMA).
+COMPUTE_UNITS = ("vpu", "mxu")
+
+#: storage-dtype axis for field buffers — ``native`` keeps the user dtype
+#: end to end; ``bf16`` stores f32 fields as bfloat16 (HBM planes, VMEM
+#: pipeline blocks, exchange messages all narrow to 2 B/cell) while the
+#: level kernels accumulate at f32 (load → upcast → compute → downcast on
+#: the final store; the ``f32_accumulate`` kernel contract).
+STORAGE_DTYPES = ("native", "bf16")
+
+
+def mxu_supported(compute_dtypes) -> bool:
+    """Structural gate for the MXU contraction form: every field must
+    COMPUTE at f32 — the banded ``dot_general`` accumulates at f32
+    (``preferred_element_type``), so an f64 field would silently lose
+    precision through the matrix unit (violating the ≤1-ulp-per-level
+    contract) and integer/bool fields have no matmul form at all.  A bf16
+    STORAGE field computes at f32 (``f32_accumulate``) and qualifies; a
+    native-bf16 field does not (its vpu path computes at bf16 in interpret
+    mode, so no cross-unit ulp contract could be pinned)."""
+    return all(jnp.dtype(dt) == jnp.float32 for dt in compute_dtypes)
+
+
+def bf16_supported(native_dtypes) -> bool:
+    """Structural gate for bf16 storage: only f32 fields narrow — the
+    downcast keeps the full f32 exponent range (losslessly-enough per the
+    analytic bound: one round-to-nearest of ≤ 2^-9 relative per store).
+    f64 would shed 45 mantissa bits (no analytic contract worth having),
+    and integer/bool fields have no bf16 form."""
+    return all(jnp.dtype(dt) == jnp.float32 for dt in native_dtypes)
+
+
+def _resolve_axis_value(request, tuned, env_name: str, choices, static: str):
+    """Shared precedence chain for the compute-unit / storage-dtype axes
+    (mirrors the exchange-route and stream-overlap rules): an explicit
+    request wins and never consults further; then the validated env knob;
+    then the tuned config's field (garbage warns and falls through); then
+    the static fallback.  Returns ``(value, source)`` pre-structural."""
+    from stencil_tpu.utils.config import env_choice
+
+    if request not in (None, "auto"):
+        if request not in choices:
+            raise ValueError(f"unknown value {request!r} (one of {choices})")
+        return request, "explicit"
+    env = env_choice(env_name, "auto", ("auto",) + tuple(choices))
+    if env != "auto":
+        return env, "env"
+    if tuned is not None:
+        if tuned in choices:
+            return str(tuned), "tuned"
+        from stencil_tpu.utils.logging import log_warn
+
+        log_warn(
+            f"tuned {env_name.lower()} value {tuned!r} is not one of "
+            f"{choices}; using the static {static!r} fallback"
+        )
+    return static, "static"
+
+
+def resolve_compute_unit(
+    request, tuned, compute_dtypes, where: str = "kernel",
+    engine_ok: bool = True,
+    engine_why: str = "this engine has no pallas level kernel",
+    emit: bool = True,
+):
+    """Resolve the compute-unit axis for one kernel build: precedence
+    explicit > ``STENCIL_COMPUTE_UNIT`` > tuned > static ``vpu``, then the
+    structural guard — an ``mxu`` the kernels cannot serve (non-f32 compute
+    dtypes, or an engine with no pallas level kernel at all) degrades to
+    ``vpu`` with a warning, never an error.  Every resolution is a
+    ``kernel.compute_unit`` telemetry event (``emit=False`` for PROSPECTIVE
+    resolutions — a planner peeking at the unit before the authoritative
+    build-time resolve emits the one real event).  Returns ``(unit, source)``."""
+    val, source = _resolve_axis_value(
+        request, tuned, "STENCIL_COMPUTE_UNIT", COMPUTE_UNITS, "vpu"
+    )
+    if val == "mxu" and not (engine_ok and mxu_supported(compute_dtypes)):
+        from stencil_tpu.utils.logging import log_warn
+
+        why = (
+            engine_why
+            if not engine_ok
+            else f"fields compute at {[jnp.dtype(d).name for d in compute_dtypes]}, not f32"
+        )
+        log_warn(
+            f"compute_unit=mxu ({source}) cannot engage for {where} ({why}); "
+            "degrading to vpu"
+        )
+        val, source = "vpu", source + "/degraded"
+    if emit:
+        from stencil_tpu import telemetry
+        from stencil_tpu.telemetry import names as tm
+
+        telemetry.emit_event(
+            tm.EVENT_KERNEL_COMPUTE_UNIT, unit=val, source=source, where=where
+        )
+    return val, source
+
+
+def resolve_storage_dtype(
+    request, tuned, native_dtypes, where: str = "kernel",
+    engine_ok: bool = True,
+    engine_why: str = (
+        "this engine accumulates at the storage dtype (no f32-accumulate "
+        "kernel)"
+    ),
+):
+    """Resolve the storage-dtype axis for one model build: precedence
+    explicit > ``STENCIL_STORAGE_DTYPE`` > tuned > static ``native``, then
+    the structural guard — ``bf16`` on non-f32 fields, or on an engine
+    whose kernels would accumulate at bf16 instead of f32 (the XLA slice
+    route), degrades to ``native`` with a warning.  Every resolution is a
+    ``kernel.storage_dtype`` telemetry event.  Returns ``(sd, source)``."""
+    val, source = _resolve_axis_value(
+        request, tuned, "STENCIL_STORAGE_DTYPE", STORAGE_DTYPES, "native"
+    )
+    if val == "bf16" and not (engine_ok and bf16_supported(native_dtypes)):
+        from stencil_tpu.utils.logging import log_warn
+
+        why = (
+            engine_why
+            if not engine_ok
+            else f"fields are {[jnp.dtype(d).name for d in native_dtypes]}, not f32"
+        )
+        log_warn(
+            f"storage_dtype=bf16 ({source}) cannot engage for {where} ({why}); "
+            "degrading to native"
+        )
+        val, source = "native", source + "/degraded"
+    from stencil_tpu import telemetry
+    from stencil_tpu.telemetry import names as tm
+
+    telemetry.emit_event(
+        tm.EVENT_KERNEL_STORAGE_DTYPE, storage=val, source=source, where=where
+    )
+    return val, source
+
+
+def band_matrix(n: int, dtype=jnp.float32) -> jax.Array:
+    """The ``(n, n)`` circulant ±1-neighbor band for the MXU contraction
+    form: ``(B @ v)[i] == v[(i-1) % n] + v[(i+1) % n]`` — exactly the
+    ``roll(v, 1) + roll(v, -1)`` pair of the vpu chain, as ONE banded
+    matmul (the wafer-scale stencil mapping: a (2r+1)-diagonal coefficient
+    band contracted against the plane, here r=1 with periodic wrap — the
+    same wrap the vpu rotate has, so shell/garbage cells keep the identical
+    dependency structure and the ≤1-ulp-per-level contract is a pure
+    summation-order statement).  Symmetric, so the same matrix serves both
+    orientations (``B @ plane`` for the sublane axis, ``plane @ B`` for the
+    lane axis).  Materialized ONCE per plan as a constant-index-map pallas
+    input — resident in VMEM at (sublane, 128)-tile-padded size, like the
+    d2 plane.  Built as a SUM of the two one-offset shift matrices (not a
+    membership predicate) so degenerate extents stay value-exact: at n=2
+    both offsets land on the same neighbor and the entry is 2.0, matching
+    the vpu chain's double-counted roll."""
+    i = jnp.arange(n)
+    d = (i[:, None] - i[None, :]) % n
+    return ((d == 1 % n).astype(dtype) + (d == (n - 1) % n).astype(dtype))
+
+
+def _make_level_sum(roll, compute_unit: str):
+    """The per-level 6-neighbor numerator, per compute unit.  ``vpu`` is
+    the historical roll+add chain VERBATIM (same left-fold order — tier-1
+    pins it bitwise); ``mxu`` replaces the four in-plane rolls with one
+    banded contraction per axis on the matrix unit
+    (``preferred_element_type=f32`` pins the accumulator — the
+    ``accum-dtype`` lint rule makes that mandatory in ops/).  The two
+    differ only in summation order, hence the ≤1-ulp-per-level contract."""
+    if compute_unit == "mxu":
+
+        def level_sum(prev, vals, cent, by, bz):
+            dn = (((1,), (0,)), ((), ()))
+            return (
+                prev
+                + vals
+                + jax.lax.dot_general(
+                    by, cent, dn, preferred_element_type=jnp.float32
+                )
+                + jax.lax.dot_general(
+                    cent, bz, dn, preferred_element_type=jnp.float32
+                )
+            )
+
+    else:
+
+        def level_sum(prev, vals, cent, by, bz):
+            del by, bz
+            return (
+                prev
+                + vals
+                + roll(cent, 1, 0)
+                + roll(cent, -1, 0)
+                + roll(cent, 1, 1)
+                + roll(cent, -1, 1)
+            )
+
+    return level_sum
+
+
+def _check_compute_unit(compute_unit: str, acc_dtype) -> None:
+    """Build-time guard: the resolvers degrade structurally-impossible
+    requests BEFORE a kernel build, so reaching a kernel with ``mxu`` on a
+    non-f32 accumulator is a wiring bug, not a user error."""
+    assert compute_unit in COMPUTE_UNITS, compute_unit
+    if compute_unit == "mxu":
+        assert jnp.dtype(acc_dtype) == jnp.float32, (
+            "mxu contraction requires an f32 accumulator; the resolver "
+            f"should have degraded this build (got {jnp.dtype(acc_dtype)})"
+        )
+
+
+def mxu_flops_per_plane(plane_y: int, plane_z: int) -> int:
+    """Analytic MXU FLOPs of ONE level over one (Y, Z) plane under the
+    banded-contraction form: the y-axis band matmul is (Y,Y)x(Y,Z) =
+    2·Y²·Z FLOPs and the z-axis (Y,Z)x(Z,Z) = 2·Y·Z² — dense FLOPs over a
+    mostly-zero band, the deliberate trade of the wafer-scale mapping
+    (~n x the vpu op count, paid on a unit with ~2 orders more FLOP/s; the
+    break-even model lives in PERF_NOTES "VPU wall").  Feeds the
+    ``kernel.mxu.flops`` telemetry counter — modeled, like the exchange
+    bytes, so the hot path stays an int multiply."""
+    return 2 * plane_y * plane_y * plane_z + 2 * plane_y * plane_z * plane_z
+
 
 def sphere_params(gx: int):
     """hot/cold sphere x-centers and the integer membership bound
@@ -127,18 +355,29 @@ def wavefront_vmem_bytes(
     itemsize: int,
     z_slabs: bool = False,
     d2_itemsize: int = 4,
+    ring_itemsize: int = None,
+    mxu: bool = False,
 ) -> int:
     """Modeled VMEM footprint of a k-level plane wavefront: 2k ring planes,
     4 pipeline (in/out double-buffer) planes, the resident d2 plane
     (``d2_itemsize`` 2 when ``pack_d2`` can clamp to int16), and (z-slab
-    variant) 4 double-buffered packed-slab blocks."""
+    variant) 4 double-buffered packed-slab blocks.  ``ring_itemsize``
+    overrides the ring planes' itemsize: bf16 STORAGE (``f32_accumulate``)
+    streams 2-byte pipeline planes but carries its level ring at f32, so
+    the ring must be modeled at 4 bytes or the gate lies.  ``mxu`` adds the
+    two resident f32 band-matrix constants of the contraction form
+    (``band_matrix``: (plane_y)² + (plane_z)² entries, tile-padded)."""
+    ring_it = itemsize if ring_itemsize is None else ring_itemsize
     plane = _padded_plane_bytes(plane_y, plane_z, itemsize)
-    est = (2 * k + 4) * plane
+    est = 2 * k * _padded_plane_bytes(plane_y, plane_z, ring_it) + 4 * plane
     if d2_itemsize:  # 0 = kernel variant with no resident d2 plane
         est += _padded_plane_bytes(plane_y, plane_z, d2_itemsize)
     if z_slabs:
         # z-major (1, 2k, plane_y) blocks: sublane-pad the 2k rows
         est += 4 * _padded_plane_bytes(2 * k, plane_y, itemsize)
+    if mxu:
+        est += _padded_plane_bytes(plane_y, plane_y, 4)
+        est += _padded_plane_bytes(plane_z, plane_z, 4)
     return est
 
 
@@ -149,8 +388,12 @@ def wavefront_vmem_fits(
     itemsize: int,
     z_slabs: bool = False,
     d2_itemsize: int = 4,
+    ring_itemsize: int = None,
+    mxu: bool = False,
 ) -> bool:
-    est = wavefront_vmem_bytes(k, plane_y, plane_z, itemsize, z_slabs, d2_itemsize)
+    est = wavefront_vmem_bytes(
+        k, plane_y, plane_z, itemsize, z_slabs, d2_itemsize, ring_itemsize, mxu
+    )
     return est + _VMEM_STACK_MARGIN <= _vmem_budget()
 
 
@@ -163,9 +406,13 @@ def pack_d2(yz_d2: jax.Array, global_size) -> jax.Array:
     return yz_d2.astype(jnp.int32)
 
 
-def warn_if_over_vmem_budget(k: int, plane_y: int, plane_z: int, itemsize: int) -> None:
-    if not wavefront_vmem_fits(k, plane_y, plane_z, itemsize):
-        est = wavefront_vmem_bytes(k, plane_y, plane_z, itemsize)
+def warn_if_over_vmem_budget(k: int, plane_y: int, plane_z: int, itemsize: int,
+                             ring_itemsize: int = None,
+                             mxu: bool = False) -> None:
+    if not wavefront_vmem_fits(k, plane_y, plane_z, itemsize,
+                               ring_itemsize=ring_itemsize, mxu=mxu):
+        est = wavefront_vmem_bytes(k, plane_y, plane_z, itemsize,
+                                   ring_itemsize=ring_itemsize, mxu=mxu)
         from stencil_tpu.utils.logging import log_warn
 
         log_warn(
@@ -176,7 +423,8 @@ def warn_if_over_vmem_budget(k: int, plane_y: int, plane_z: int, itemsize: int) 
 
 
 def choose_temporal_k(
-    shape: Tuple[int, int, int], itemsize: int, requested="auto", tune_key=None
+    shape: Tuple[int, int, int], itemsize: int, requested="auto",
+    tune_key=None, ring_itemsize: int = None, mxu: bool = False,
 ) -> int:
     """Pick the wrap kernel's temporal blocking depth: the deepest k whose
     VMEM footprint fits the calibrated budget (``auto``), or a validated
@@ -188,13 +436,20 @@ def choose_temporal_k(
     chip/shape/dtype wins over the static model below (which is the v5e
     calibration, kept as the no-tune/cold-cache fallback — docs/tuning.md).
     A tuned depth may legitimately exceed ``_WRAP_MAX_K``: the plateau is a
-    property of the probed chip, not the kernel."""
+    property of the probed chip, not the kernel.
+
+    ``ring_itemsize`` overrides the level ring's itemsize in the VMEM
+    model: under bf16 STORAGE the pipeline planes stream at 2 B but the
+    ring carries the f32 accumulator (the ``f32_accumulate`` contract), so
+    a storage-itemsize-only model would admit depths whose f32 ring blows
+    the budget.  ``mxu`` folds the contraction form's two resident band
+    matrices into the model the same way."""
     X, Y, Z = shape
     if requested != "auto":
         k = int(requested)
         if not 1 <= k <= max(1, X // 2):
             raise ValueError(f"temporal_k={k} needs 1 <= k <= X//2 = {X // 2}")
-        warn_if_over_vmem_budget(k, Y, Z, itemsize)
+        warn_if_over_vmem_budget(k, Y, Z, itemsize, ring_itemsize, mxu=mxu)
         return k
     if tune_key is not None:
         from stencil_tpu import tune
@@ -213,7 +468,9 @@ def choose_temporal_k(
             )
     k = 1
     for cand in range(2, _WRAP_MAX_K + 1):
-        if cand <= X // 2 and wavefront_vmem_fits(cand, Y, Z, itemsize):
+        if cand <= X // 2 and wavefront_vmem_fits(
+            cand, Y, Z, itemsize, ring_itemsize=ring_itemsize, mxu=mxu
+        ):
             k = cand
     return k
 
@@ -271,6 +528,13 @@ def jacobi_wrap_step(
     block: jax.Array,
     interpret: bool = False,
     k: int = 1,
+    compute_unit: str = "vpu",  # "vpu" = the historical roll+add chain
+    # (bitwise-pinned); "mxu" = one banded contraction per in-plane axis on
+    # the matrix unit (band_matrix + _make_level_sum; ≤1 ulp/level vs vpu)
+    f32_accumulate: bool = False,  # bf16-STORAGE variant: the block streams
+    # at its (narrow) dtype but the kernel upcasts at load, carries the
+    # level ring and all arithmetic at f32, and downcasts ONCE at the final
+    # store — one round-to-nearest per k levels instead of one per level
 ) -> jax.Array:
     """``k`` Jacobi iterations over the WHOLE (unsharded) domain with the
     periodic wrap folded into the kernel — the single-device fast path.
@@ -306,48 +570,59 @@ def jacobi_wrap_step(
     hot_x, cold_x, in_r2 = sphere_params(gx)
 
     roll = _make_roll(interpret)
+    acc_dtype = jnp.float32 if f32_accumulate else block.dtype
+    _check_compute_unit(compute_unit, acc_dtype)
+    mxu = compute_unit == "mxu"
+    level_sum = _make_level_sum(roll, compute_unit)
 
-    def kernel(in_ref, d2_ref, out_ref, ring):
+    def kernel(in_ref, d2_ref, *rest):
+        if mxu:
+            by_ref, bz_ref, out_ref, ring = rest
+            by, bz = by_ref[...], bz_ref[...]
+        else:
+            out_ref, ring = rest
+            by = bz = None
         # ring[s] holds the two most recent level-s planes (level 0 = input)
         i = pl.program_id(0)
         d2 = d2_ref[...]
-        vals = in_ref[0]  # level-0 plane i (mod X)
+        vals = in_ref[0].astype(acc_dtype)  # level-0 plane i (mod X)
         for s in range(1, k + 1):
             # level-s plane (i - s) from level-(s-1) planes (i-s-1, i-s,
             # i-s+1); early steps compute garbage that the replay rewrites
             prev = ring[s - 1, i % 2]  # plane i-s-1
             cent = ring[s - 1, (i + 1) % 2]  # plane i-s
             ring[s - 1, i % 2] = vals  # push plane i-s+1 (after prev read)
-            val = (
-                prev
-                + vals
-                + roll(cent, 1, 0)
-                + roll(cent, -1, 0)
-                + roll(cent, 1, 1)
-                + roll(cent, -1, 1)
-            ) / 6.0
+            val = level_sum(prev, vals, cent, by, bz) / 6.0
             x_g = (i - s) % X
             val = jnp.where(d2 < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
             val = jnp.where(d2 < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
-            vals = val.astype(vals.dtype)
-        out_ref[0] = vals  # level-k plane (i - k) % X; last write is valid
+            vals = val.astype(acc_dtype)
+        # level-k plane (i - k) % X; last write is valid.  The one downcast
+        # of the f32_accumulate contract happens here.
+        out_ref[0] = vals.astype(block.dtype)
 
     d2 = yz_dist2_plane(0, 0, (Y, Z), block.shape)
 
+    const = lambda a, b: pl.BlockSpec((a, b), lambda i: (0, 0))
+    in_specs = [
+        pl.BlockSpec((1, Y, Z), lambda i: (i % X, 0, 0)),
+        # constant index map: fetched once, stays resident in VMEM
+        const(Y, Z),
+    ]
+    args = [block, d2.astype(jnp.int32)]
+    if mxu:
+        in_specs += [const(Y, Y), const(Z, Z)]
+        args += [band_matrix(Y), band_matrix(Z)]
     return pl.pallas_call(
         kernel,
         grid=(X + 2 * k,),
-        in_specs=[
-            pl.BlockSpec((1, Y, Z), lambda i: (i % X, 0, 0)),
-            # constant index map: fetched once, stays resident in VMEM
-            pl.BlockSpec((Y, Z), lambda i: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Y, Z), lambda i: ((i - k) % X, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
-        scratch_shapes=[pltpu.VMEM((k, 2, Y, Z), block.dtype)],
+        scratch_shapes=[pltpu.VMEM((k, 2, Y, Z), acc_dtype)],
         interpret=interpret,
         **_tpu_compiler_params(interpret),
-    )(block, d2.astype(jnp.int32))
+    )(*args)
 
 
 def jacobi_shell_wavefront_step(
@@ -386,6 +661,10 @@ def jacobi_shell_wavefront_step(
     # garbage rolls into halo column 0 / z_valid-1 at level 1 — columns that
     # are only valid at level 0 anyway, so the shrinking-validity argument is
     # unchanged: level s remains valid on [s, z_valid - s).
+    compute_unit: str = "vpu",  # "mxu" = one banded in-plane contraction
+    # per axis on the matrix unit (see jacobi_wrap_step); ≤1 ulp/level vs vpu
+    f32_accumulate: bool = False,  # bf16-storage variant: upcast at load,
+    # f32 level ring + arithmetic, ONE downcast at the final store/emit
 ) -> jax.Array:
     """``m`` Jacobi levels over an m-shell-carrying shard in ONE pass — the
     multi-device temporal-blocking path.
@@ -429,8 +708,17 @@ def jacobi_shell_wavefront_step(
     hot_x, cold_x, in_r2 = sphere_params(gx)
 
     roll = _make_roll(interpret)
+    acc_dtype = jnp.float32 if f32_accumulate else raw.dtype
+    _check_compute_unit(compute_unit, acc_dtype)
+    mxu = compute_unit == "mxu"
+    level_sum = _make_level_sum(roll, compute_unit)
 
     def kernel(origin_ref, in_ref, d2_ref, *rest):
+        if mxu:
+            by_ref, bz_ref, rest = rest[0], rest[1], rest[2:]
+            by, bz = by_ref[...], bz_ref[...]
+        else:
+            by = bz = None
         if z_slabs is not None:
             zs_ref, out_ref, zout_ref, ring = rest
         else:
@@ -438,12 +726,12 @@ def jacobi_shell_wavefront_step(
         # ring[s] holds the two most recent level-s planes (level 0 = input)
         i = pl.program_id(0)
         d2v = d2_ref[...]
-        vals = in_ref[0]  # level-0 raw plane i
+        vals = in_ref[0].astype(acc_dtype)  # level-0 raw plane i
         if z_slabs is not None:
             # patch the z-shell columns in VMEM — they are never stored in
             # the big array.  One small (2s, Yr) -> (Yr, 2s) transpose per
             # plane turns the z-major block into the column vectors needed.
-            zst = jnp.swapaxes(zs_ref[0], 0, 1)  # (Yr, 2s)
+            zst = jnp.swapaxes(zs_ref[0], 0, 1).astype(acc_dtype)  # (Yr, 2s)
             col = jax.lax.broadcasted_iota(jnp.int32, (Yr, Zr), 1)
             for j in range(s_off):
                 vals = jnp.where(col == j, zst[:, j][:, None], vals)
@@ -454,14 +742,7 @@ def jacobi_shell_wavefront_step(
             prev = ring[s - 1, i % 2]  # level-(s-1) plane i-s-1
             cent = ring[s - 1, (i + 1) % 2]  # level-(s-1) plane i-s
             ring[s - 1, i % 2] = vals  # push plane i-s+1 (after prev read)
-            val = (
-                prev
-                + vals
-                + roll(cent, 1, 0)
-                + roll(cent, -1, 0)
-                + roll(cent, 1, 1)
-                + roll(cent, -1, 1)
-            ) / 6.0
+            val = level_sum(prev, vals, cent, by, bz) / 6.0
             # global x of level-s plane i-s (raw index -> interior-origin
             # coords; + gx keeps lax.rem's operand non-negative:
             # i-s-s_off >= -2*s_off > -gx).  Shell planes matter too: their
@@ -473,8 +754,10 @@ def jacobi_shell_wavefront_step(
 
             val = jnp.where(d2v < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
             val = jnp.where(d2v < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
-            vals = val.astype(vals.dtype)
-        out_ref[0] = vals  # level-m plane i-m; valid for interior planes
+            vals = val.astype(acc_dtype)
+        # level-m plane i-m; valid for interior planes.  The f32_accumulate
+        # contract's ONE downcast happens at this store (and the slab emit).
+        out_ref[0] = vals.astype(raw.dtype)
         if z_slabs is not None:
             # emit next macro's outgoing z slabs: my interior z-boundary
             # columns at the output level (shell planes/rows carry garbage
@@ -483,7 +766,7 @@ def jacobi_shell_wavefront_step(
             emit = jnp.concatenate(
                 [vals[:, zv - 2 * s_off : zv - s_off], vals[:, s_off : 2 * s_off]],
                 axis=1,
-            )  # (Yr, 2s)
+            ).astype(raw.dtype)  # (Yr, 2s)
             zout_ref[0] = jnp.swapaxes(emit, 0, 1)
 
     out_idx = lambda i: (jnp.maximum(i - m, 0), 0, 0)
@@ -497,6 +780,14 @@ def jacobi_shell_wavefront_step(
     out_specs = pl.BlockSpec((1, Yr, Zr), out_idx)
     out_shape = jax.ShapeDtypeStruct((Xr, Yr, Zr), raw.dtype)
     args = [origin.astype(jnp.int32), raw, d2]
+    if mxu:
+        # resident band-matrix constants of the contraction form, fetched
+        # once like the d2 plane
+        in_specs += [
+            pl.BlockSpec((Yr, Yr), lambda i: (0, 0)),
+            pl.BlockSpec((Zr, Zr), lambda i: (0, 0)),
+        ]
+        args += [band_matrix(Yr), band_matrix(Zr)]
     if z_slabs is not None:
         assert z_slabs.shape == (Xr, 2 * s_off, Yr), (z_slabs.shape, raw.shape)
         in_specs += [pl.BlockSpec((1, 2 * s_off, Yr), lambda i: (i, 0, 0))]
@@ -519,7 +810,7 @@ def jacobi_shell_wavefront_step(
         # m+1 planes, so aliasing is hazard-free; unwritten high-shell planes
         # keep their pre-step bytes
         input_output_aliases={1: 0} if alias else {},
-        scratch_shapes=[pltpu.VMEM((m, 2, Yr, Zr), raw.dtype)],
+        scratch_shapes=[pltpu.VMEM((m, 2, Yr, Zr), acc_dtype)],
         interpret=interpret,
         **_tpu_compiler_params(interpret),
     )(*args)
@@ -563,6 +854,11 @@ def jacobi_zring_wavefront_step(
     interior_offset: int = None,
     alias: bool = False,
     interpret: bool = False,
+    compute_unit: str = "vpu",  # "mxu" = banded in-plane contraction over
+    # the RING-layout working plane (the circulant wrap of band_matrix is
+    # exactly the ring seam's lane wrap); ≤1 ulp/level vs "vpu"
+    f32_accumulate: bool = False,  # bf16-storage variant (see
+    # jacobi_shell_wavefront_step)
 ):
     """``m`` Jacobi levels per pass with the z halo in a RING-layout VMEM
     working plane — the deep-wavefront path that streams NO z padding.
@@ -605,14 +901,24 @@ def jacobi_zring_wavefront_step(
     assert z_slabs.shape == (Xr, 2 * s_off, Yr), (z_slabs.shape, raw.shape)
     hot_x, cold_x, in_r2 = sphere_params(gx)
     roll = _make_roll(interpret)
+    acc_dtype = jnp.float32 if f32_accumulate else raw.dtype
+    _check_compute_unit(compute_unit, acc_dtype)
+    mxu = compute_unit == "mxu"
+    level_sum = _make_level_sum(roll, compute_unit)
 
-    def kernel(origin_ref, in_ref, d2_ref, zs_ref, out_ref, zout_ref, ring):
+    def kernel(origin_ref, in_ref, d2_ref, zs_ref, *rest):
+        if mxu:
+            by_ref, bz_ref, out_ref, zout_ref, ring = rest
+            by, bz = by_ref[...], bz_ref[...]
+        else:
+            out_ref, zout_ref, ring = rest
+            by = bz = None
         i = pl.program_id(0)
         d2v = d2_ref[...]
         # stage the interior plane at lane offset OFF and patch the halo
         # segments from the slab block (one small transpose per plane)
-        vals = jnp.pad(in_ref[0], ((0, 0), (OFF, 0)))
-        zst = jnp.swapaxes(zs_ref[0], 0, 1)  # (Yr, 2s)
+        vals = jnp.pad(in_ref[0].astype(acc_dtype), ((0, 0), (OFF, 0)))
+        zst = jnp.swapaxes(zs_ref[0], 0, 1).astype(acc_dtype)  # (Yr, 2s)
         col = jax.lax.broadcasted_iota(jnp.int32, (Yr, W), 1)
         for j in range(s_off):
             vals = jnp.where(col == OFF - s_off + j, zst[:, j][:, None], vals)
@@ -621,38 +927,42 @@ def jacobi_zring_wavefront_step(
             prev = ring[s - 1, i % 2]
             cent = ring[s - 1, (i + 1) % 2]
             ring[s - 1, i % 2] = vals
-            val = (
-                prev
-                + vals
-                + roll(cent, 1, 0)
-                + roll(cent, -1, 0)
-                + roll(cent, 1, 1)
-                + roll(cent, -1, 1)
-            ) / 6.0
+            val = level_sum(prev, vals, cent, by, bz) / 6.0
             x_g = jax.lax.rem(
                 origin_ref[0] + jnp.int32(gx) + i - jnp.int32(s + s_off), jnp.int32(gx)
             )
             val = jnp.where(d2v < in_r2 - (x_g - hot_x) ** 2, HOT_TEMP, val)
             val = jnp.where(d2v < in_r2 - (x_g - cold_x) ** 2, COLD_TEMP, val)
-            vals = val.astype(vals.dtype)
-        out_ref[0] = vals[:, OFF:]  # level-m plane i-m, interior lanes
+            vals = val.astype(acc_dtype)
+        # level-m plane i-m, interior lanes (the f32_accumulate downcast)
+        out_ref[0] = vals[:, OFF:].astype(raw.dtype)
         # outgoing slabs: top interior cols [Zi-s, Zi) = lanes [W-s, W)
         # (the -z-bound message), bottom cols [0, s) = lanes [OFF, OFF+s)
         emit = jnp.concatenate(
             [vals[:, W - s_off : W], vals[:, OFF : OFF + s_off]], axis=1
-        )
+        ).astype(raw.dtype)
         zout_ref[0] = jnp.swapaxes(emit, 0, 1)
 
     out_idx = lambda i: (jnp.maximum(i - m, 0), 0, 0)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, Yr, Zi), lambda i: (i, 0, 0)),
+        pl.BlockSpec((Yr, W), lambda i: (0, 0)),  # resident d2
+        pl.BlockSpec((1, 2 * s_off, Yr), lambda i: (i, 0, 0)),
+    ]
+    args = [origin.astype(jnp.int32), raw, d2, z_slabs]
+    if mxu:
+        # the z band spans the WORKING plane width W: the circulant wrap at
+        # lanes 0/W-1 is exactly the ring layout's periodic-consistent seam
+        in_specs += [
+            pl.BlockSpec((Yr, Yr), lambda i: (0, 0)),
+            pl.BlockSpec((W, W), lambda i: (0, 0)),
+        ]
+        args += [band_matrix(Yr), band_matrix(W)]
     return pl.pallas_call(
         kernel,
         grid=(Xr,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, Yr, Zi), lambda i: (i, 0, 0)),
-            pl.BlockSpec((Yr, W), lambda i: (0, 0)),  # resident d2
-            pl.BlockSpec((1, 2 * s_off, Yr), lambda i: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, Yr, Zi), out_idx),
             pl.BlockSpec((1, 2 * s_off, Yr), out_idx),
@@ -662,10 +972,10 @@ def jacobi_zring_wavefront_step(
             jax.ShapeDtypeStruct((Xr, 2 * s_off, Yr), raw.dtype),
         ),
         input_output_aliases={1: 0} if alias else {},
-        scratch_shapes=[pltpu.VMEM((m, 2, Yr, W), raw.dtype)],
+        scratch_shapes=[pltpu.VMEM((m, 2, Yr, W), acc_dtype)],
         interpret=interpret,
         **_tpu_compiler_params(interpret),
-    )(origin.astype(jnp.int32), raw, d2, z_slabs)
+    )(*args)
 
 
 def jacobi_slab_step(
@@ -680,6 +990,9 @@ def jacobi_slab_step(
     yz_d2: jax.Array,  # (Y, Z) int32 from yz_dist2_plane over the FULL plane
     global_size: Tuple[int, int, int],
     interpret: bool = False,
+    f32_accumulate: bool = False,  # bf16-storage variant: the six-neighbor
+    # mean is computed at f32 and rounded once at the store (single-level
+    # kernel, so "accumulate" here is just the mean's arithmetic dtype)
 ) -> jax.Array:
     """One Jacobi iteration consuming received halo slabs DIRECTLY as kernel
     inputs — the multi-device fast path.
@@ -746,6 +1059,11 @@ def jacobi_slab_step(
 
             left = jnp.where(col == 0, zcol(zlo_ref), left)
             right = jnp.where(col == Z - 1, zcol(zhi_ref), right)
+            if f32_accumulate:
+                prev, nxt, up, down, left, right = (
+                    t.astype(jnp.float32)
+                    for t in (prev, nxt, up, down, left, right)
+                )
             val = (prev + nxt + up + down + left + right) / 6.0
             x_g = (origin_ref[0] + o) % gx
             d2 = d2_ref[...]
@@ -803,6 +1121,8 @@ def jacobi_plane_step(
     yz_d2: jax.Array,  # (Y-2, Z-2) int32 from yz_dist2_plane
     global_size: Tuple[int, int, int],
     interpret: bool = False,
+    f32_accumulate: bool = False,  # bf16-storage variant: f32 mean, one
+    # downcast at the interior store (halo ring passes through untouched)
 ) -> jax.Array:
     """One Jacobi iteration over a radius-1 shell-carrying block (X, Y, Z)."""
     from jax.experimental import pallas as pl
@@ -824,13 +1144,18 @@ def jacobi_plane_step(
         def _():
             prev = ring[i % 2]  # plane i-2
             cent = ring[(i + 1) % 2]  # plane i-1
+            up = (
+                (lambda v: v.astype(jnp.float32))
+                if f32_accumulate
+                else (lambda v: v)
+            )
             mean = (
-                prev[1:-1, 1:-1]
-                + cur[1:-1, 1:-1]
-                + cent[:-2, 1:-1]
-                + cent[2:, 1:-1]
-                + cent[1:-1, :-2]
-                + cent[1:-1, 2:]
+                up(prev[1:-1, 1:-1])
+                + up(cur[1:-1, 1:-1])
+                + up(cent[:-2, 1:-1])
+                + up(cent[2:, 1:-1])
+                + up(cent[1:-1, :-2])
+                + up(cent[1:-1, 2:])
             ) / 6.0
             # raw plane i-1 -> interior x = i-2; sphere test per cell is just
             # a compare of the precomputed y/z distances against a scalar

@@ -22,6 +22,11 @@ def mean6_shell_wavefront_step(
     m: int,  # levels to advance, <= the shell width s
     shell_width: int,
     interpret: bool = False,
+    compute_unit: str = "vpu",  # "mxu" = one banded in-plane contraction
+    # per axis on the matrix unit (ops/jacobi_pallas.band_matrix); ≤1
+    # ulp/level vs the "vpu" roll+add chain
+    f32_accumulate: bool = False,  # bf16-storage variant: upcast at load,
+    # f32 level ring + arithmetic, one downcast at the final store
 ) -> jax.Array:
     """``m`` mean-of-6 levels in ONE pass over an s-shell-carrying shard —
     the Astaroth proxy's temporal wavefront (opt-in ``schedule="wavefront"``).
@@ -38,55 +43,88 @@ def mean6_shell_wavefront_step(
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    from stencil_tpu.ops.jacobi_pallas import _make_roll, _tpu_compiler_params
+    from stencil_tpu.ops.jacobi_pallas import (
+        _check_compute_unit,
+        _make_level_sum,
+        _make_roll,
+        _tpu_compiler_params,
+        band_matrix,
+    )
 
     Xr, Yr, Zr = raw.shape
     assert 1 <= m <= shell_width and 2 * shell_width < min(Xr, Yr, Zr), (
         m, shell_width, raw.shape,
     )
     roll = _make_roll(interpret)
+    acc_dtype = jnp.float32 if f32_accumulate else raw.dtype
+    _check_compute_unit(compute_unit, acc_dtype)
+    mxu = compute_unit == "mxu"
+    level_sum = _make_level_sum(roll, compute_unit)
 
-    def kernel(in_ref, out_ref, ring):
+    def kernel(in_ref, *rest):
+        if mxu:
+            by_ref, bz_ref, out_ref, ring = rest
+            by, bz = by_ref[...], bz_ref[...]
+        else:
+            out_ref, ring = rest
+            by = bz = None
         # ring[s] holds the two most recent level-s planes (level 0 = input)
         i = pl.program_id(0)
-        vals = in_ref[0]  # level-0 raw plane i
+        vals = in_ref[0].astype(acc_dtype)  # level-0 raw plane i
         for s in range(1, m + 1):
             prev = ring[s - 1, i % 2]  # level-(s-1) plane i-s-1
             cent = ring[s - 1, (i + 1) % 2]  # level-(s-1) plane i-s
             ring[s - 1, i % 2] = vals  # push plane i-s+1 (after prev read)
-            val = (
-                prev
-                + vals
-                + roll(cent, 1, 0)
-                + roll(cent, -1, 0)
-                + roll(cent, 1, 1)
-                + roll(cent, -1, 1)
-            ) / 6.0
-            vals = val.astype(vals.dtype)
-        out_ref[0] = vals  # level-m plane i-m; valid for the interior
+            val = level_sum(prev, vals, cent, by, bz) / 6.0
+            vals = val.astype(acc_dtype)
+        # level-m plane i-m; valid for the interior (the one f32_accumulate
+        # downcast)
+        out_ref[0] = vals.astype(raw.dtype)
 
+    in_specs = [pl.BlockSpec((1, Yr, Zr), lambda i: (i, 0, 0))]
+    args = [raw]
+    if mxu:
+        in_specs += [
+            pl.BlockSpec((Yr, Yr), lambda i: (0, 0)),
+            pl.BlockSpec((Zr, Zr), lambda i: (0, 0)),
+        ]
+        args += [band_matrix(Yr), band_matrix(Zr)]
     return pl.pallas_call(
         kernel,
         grid=(Xr,),
-        in_specs=[pl.BlockSpec((1, Yr, Zr), lambda i: (i, 0, 0))],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Yr, Zr), lambda i: (jnp.maximum(i - m, 0), 0, 0)),
         out_shape=jax.ShapeDtypeStruct((Xr, Yr, Zr), raw.dtype),
         # write of plane i-m trails the fetch of plane i+1: in-place safe
         input_output_aliases={0: 0},
-        scratch_shapes=[pltpu.VMEM((m, 2, Yr, Zr), raw.dtype)],
+        scratch_shapes=[pltpu.VMEM((m, 2, Yr, Zr), acc_dtype)],
         interpret=interpret,
         **_tpu_compiler_params(interpret),
-    )(raw)
+    )(*args)
 
 
 def mean6_plane_step(
-    block: jax.Array, lo: Dim3, hi: Dim3, interpret: bool = False
+    block: jax.Array, lo: Dim3, hi: Dim3, interpret: bool = False,
+    compute_unit: str = "vpu", f32_accumulate: bool = False,
 ) -> jax.Array:
-    """One mean-of-6-face-neighbors iteration over a shell-carrying block."""
+    """One mean-of-6-face-neighbors iteration over a shell-carrying block.
+
+    ``compute_unit="mxu"`` computes the in-plane neighbor pair sums as one
+    banded contraction per axis (``band_matrix``); the interior window
+    ``[y0, y1) x [z0, z1)`` sits at least one cell inside the plane, so the
+    circulant wrap rows/columns never enter the sliced result and the
+    contraction is exactly the shifted-slice sum up to summation order
+    (≤1 ulp).  ``f32_accumulate`` is the bf16-storage variant: the mean is
+    computed at f32 and rounded once at the interior store (pass-through
+    shell planes keep their storage bytes untouched)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    from stencil_tpu.ops.jacobi_pallas import _tpu_compiler_params
+    from stencil_tpu.ops.jacobi_pallas import (
+        _check_compute_unit,
+        _tpu_compiler_params,
+        band_matrix,
+    )
 
     X, Y, Z = block.shape
     # every side needs >= 1 shell cell: the distance-1 reads and the
@@ -94,8 +132,16 @@ def mean6_plane_step(
     assert lo.all_ge(1) and hi.all_ge(1), (lo, hi)
     y0, y1 = lo.y, Y - hi.y
     z0, z1 = lo.z, Z - hi.z
+    acc_dtype = jnp.float32 if f32_accumulate else block.dtype
+    _check_compute_unit(compute_unit, acc_dtype)
+    mxu = compute_unit == "mxu"
+    up = (lambda v: v.astype(jnp.float32)) if f32_accumulate else (lambda v: v)
 
-    def kernel(in_ref, out_ref, ring):
+    def kernel(in_ref, *rest):
+        if mxu:
+            by_ref, bz_ref, out_ref, ring = rest
+        else:
+            out_ref, ring = rest
         i = pl.program_id(0)
         cur = in_ref[0]
 
@@ -112,14 +158,28 @@ def mean6_plane_step(
             @pl.when(in_window)
             def _():
                 prev = ring[i % 2]  # plane i-2
-                mean = (
-                    prev[y0:y1, z0:z1]
-                    + cur[y0:y1, z0:z1]
-                    + cent[y0 - 1 : y1 - 1, z0:z1]
-                    + cent[y0 + 1 : y1 + 1, z0:z1]
-                    + cent[y0:y1, z0 - 1 : z1 - 1]
-                    + cent[y0:y1, z0 + 1 : z1 + 1]
-                ) / 6.0
+                if mxu:
+                    c = up(cent)
+                    dn = (((1,), (0,)), ((), ()))
+                    nbr = jax.lax.dot_general(
+                        by_ref[...], c, dn, preferred_element_type=jnp.float32
+                    ) + jax.lax.dot_general(
+                        c, bz_ref[...], dn, preferred_element_type=jnp.float32
+                    )
+                    mean = (
+                        up(prev[y0:y1, z0:z1])
+                        + up(cur[y0:y1, z0:z1])
+                        + nbr[y0:y1, z0:z1]
+                    ) / 6.0
+                else:
+                    mean = (
+                        up(prev[y0:y1, z0:z1])
+                        + up(cur[y0:y1, z0:z1])
+                        + up(cent[y0 - 1 : y1 - 1, z0:z1])
+                        + up(cent[y0 + 1 : y1 + 1, z0:z1])
+                        + up(cent[y0:y1, z0 - 1 : z1 - 1])
+                        + up(cent[y0:y1, z0 + 1 : z1 + 1])
+                    ) / 6.0
                 out_ref[0] = cent  # keep the y/z shell
                 out_ref[0, y0:y1, z0:z1] = mean.astype(cur.dtype)
 
@@ -131,13 +191,21 @@ def mean6_plane_step(
         def _():
             ring[i % 2] = cur
 
+    in_specs = [pl.BlockSpec((1, Y, Z), lambda i: (jnp.minimum(i, X - 1), 0, 0))]
+    args = [block]
+    if mxu:
+        in_specs += [
+            pl.BlockSpec((Y, Y), lambda i: (0, 0)),
+            pl.BlockSpec((Z, Z), lambda i: (0, 0)),
+        ]
+        args += [band_matrix(Y), band_matrix(Z)]
     return pl.pallas_call(
         kernel,
         grid=(X + 1,),
-        in_specs=[pl.BlockSpec((1, Y, Z), lambda i: (jnp.minimum(i, X - 1), 0, 0))],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Y, Z), lambda i: (jnp.clip(i - 1, 0, X - 1), 0, 0)),
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), block.dtype),
         scratch_shapes=[pltpu.VMEM((2, Y, Z), block.dtype)],
         interpret=interpret,
         **_tpu_compiler_params(interpret),
-    )(block)
+    )(*args)

@@ -84,12 +84,16 @@ from stencil_tpu.utils.compat import shard_map
 from stencil_tpu import telemetry
 from stencil_tpu.telemetry import names as tm
 from stencil_tpu.ops.jacobi_pallas import (
+    COMPUTE_UNITS,
     _make_roll,
     _padded_plane_bytes,
     _tpu_compiler_params,
     _vmem_budget,
     _VMEM_STACK_MARGIN,
     _WRAP_MAX_K,
+    band_matrix,
+    mxu_flops_per_plane,
+    resolve_compute_unit,
 )
 
 
@@ -108,12 +112,23 @@ class PlaneView:
     x offset selects one of the ``2r+1`` VMEM-resident planes, the y/z
     offsets are in-plane rotates.  Rotate wraparound at the plane edges only
     contaminates shell cells the validity contract already sacrifices.
+
+    ``plane_nbr_sum()`` is the compute-unit seam for AXIS-SEPARABLE
+    kernels: the sum of the four in-plane face neighbors of the center
+    plane, lowered as the historical roll+add chain under ``vpu`` or as ONE
+    banded contraction per axis on the matrix unit under ``mxu``
+    (``bands`` set — ops/jacobi_pallas.band_matrix; ≤1 ulp vs the chain,
+    a pure summation-order difference).  A kernel's ``mxu`` form
+    (``make_stream_step(mxu_kernel=...)``) writes its separable in-plane
+    taps through this helper; kernels with no such form never see bands
+    and structurally degrade to ``vpu``.
     """
 
-    def __init__(self, window: Tuple[jax.Array, ...], roll):
+    def __init__(self, window: Tuple[jax.Array, ...], roll, bands=None):
         self._window = window
         self._r = (len(window) - 1) // 2
         self._roll = roll
+        self._bands = bands  # (by, bz) f32 band matrices, or None (= vpu)
 
     def sh(self, dx: int = 0, dy: int = 0, dz: int = 0) -> jax.Array:
         # ALL axes are bounded by the declared read radius: an in-plane
@@ -129,6 +144,25 @@ class PlaneView:
         if dz:
             v = self._roll(v, -dz, 1)
         return v
+
+    def plane_nbr_sum(self) -> jax.Array:
+        """``sh(0,1,0) + sh(0,-1,0) + sh(0,0,1) + sh(0,0,-1)`` — on the MXU
+        as two banded matmuls when this view carries band matrices."""
+        c = self.center()
+        if self._bands is not None:
+            by, bz = self._bands
+            dn = (((1,), (0,)), ((), ()))
+            return jax.lax.dot_general(
+                by, c, dn, preferred_element_type=jnp.float32
+            ) + jax.lax.dot_general(
+                c, bz, dn, preferred_element_type=jnp.float32
+            )
+        return (
+            self.sh(0, 1, 0)
+            + self.sh(0, -1, 0)
+            + self.sh(0, 0, 1)
+            + self.sh(0, 0, -1)
+        )
 
     def center(self) -> jax.Array:
         return self._window[self._r]
@@ -177,6 +211,11 @@ def stream_plane_pass(
     origin: jax.Array,  # (3,) int32 global coords of the interior start
     global_size: Dim3,
     interpret: bool = False,
+    compute_unit: str = "vpu",  # "mxu": band matrices ride in as resident
+    # constants and the views' plane_nbr_sum contracts on the matrix unit
+    f32_accumulate: bool = False,  # bf16-storage variant: planes upcast to
+    # f32 for the kernel, one downcast at the interior store (pass-through
+    # shell planes keep their storage bytes bit-exact)
 ) -> List[jax.Array]:
     """ONE kernel level over shell-carrying blocks, streaming x-planes with a
     ``2r``-deep ring per quantity; shell planes and the in-plane shell ring
@@ -195,9 +234,17 @@ def stream_plane_pass(
     z0, z1 = lo.z, Z - hi.z
     roll = _make_roll(interpret)
     gsize = global_size
+    mxu = compute_unit == "mxu"
+    up = (lambda v: v.astype(jnp.float32)) if f32_accumulate else (lambda v: v)
 
     def body(origin_ref, *refs):
         in_refs = refs[:nq]
+        if mxu:
+            by_ref, bz_ref = refs[nq], refs[nq + 1]
+            bands = (by_ref[...], bz_ref[...])
+            refs = refs[: nq] + refs[nq + 2 :]
+        else:
+            bands = None
         out_refs = refs[nq : 2 * nq]
         rings = refs[2 * nq :]
         i = pl.program_id(0)
@@ -218,8 +265,9 @@ def stream_plane_pass(
             def _():
                 views = {
                     names[q]: PlaneView(
-                        tuple(plane(q, 2 * r - d) for d in range(2 * r + 1)),
+                        tuple(up(plane(q, 2 * r - d)) for d in range(2 * r + 1)),
                         roll,
+                        bands=bands,
                     )
                     for q in range(nq)
                 }
@@ -261,6 +309,14 @@ def stream_plane_pass(
         pl.BlockSpec((1, Y, Z), lambda i: (jnp.minimum(i, X - 1), 0, 0))
         for _ in range(nq)
     ]
+    args = [origin.astype(jnp.int32), *raws]
+    if mxu:
+        # resident band-matrix constants, fetched once like the d2 plane
+        in_specs += [
+            pl.BlockSpec((Y, Y), lambda i: (0, 0)),
+            pl.BlockSpec((Z, Z), lambda i: (0, 0)),
+        ]
+        args += [band_matrix(Y), band_matrix(Z)]
     out_specs = tuple(
         pl.BlockSpec((1, Y, Z), lambda i: (jnp.clip(i - r, 0, X - 1), 0, 0))
         for _ in range(nq)
@@ -279,7 +335,7 @@ def stream_plane_pass(
         ],
         interpret=interpret,
         **_tpu_compiler_params(interpret),
-    )(origin.astype(jnp.int32), *raws)
+    )(*args)
     return list(outs) if nq > 1 else [outs]
 
 
@@ -295,6 +351,10 @@ def stream_wavefront_pass(
     z_valid: int = None,  # logical plane width; [z_valid, Zr) is lane padding
     alias: bool = False,
     interpret: bool = False,
+    compute_unit: str = "vpu",  # "mxu": resident band matrices + contraction
+    # via the views' plane_nbr_sum (see stream_plane_pass)
+    f32_accumulate: bool = False,  # bf16-storage variant: upcast at load,
+    # f32 level rings + arithmetic, one downcast at the final store/emit
 ):
     """``m`` kernel levels in ONE pass over ``s_off``-shell-carrying shards —
     the user-kernel generalization of ``jacobi_shell_wavefront_step`` (see
@@ -312,27 +372,39 @@ def stream_wavefront_pass(
     gsize = global_size
     assert 2 * s_off < gsize.x, (s_off, gsize)  # non-negative lax.rem operand
     roll = _make_roll(interpret)
+    mxu = compute_unit == "mxu"
+    acc_dtypes = [
+        jnp.float32 if f32_accumulate else b.dtype for b in raws
+    ]
+    up = (lambda v: v.astype(jnp.float32)) if f32_accumulate else (lambda v: v)
 
     def body(origin_ref, *refs):
         in_refs = refs[:nq]
-        if z_slabs is not None:
-            zs_refs = refs[nq : 2 * nq]
-            out_refs = refs[2 * nq : 3 * nq]
-            zout_refs = refs[3 * nq : 4 * nq]
-            rings = refs[4 * nq :]
+        refs = refs[nq:]
+        if mxu:
+            bands = (refs[0][...], refs[1][...])
+            refs = refs[2:]
         else:
+            bands = None
+        if z_slabs is not None:
+            zs_refs = refs[:nq]
             out_refs = refs[nq : 2 * nq]
+            zout_refs = refs[2 * nq : 3 * nq]
+            rings = refs[3 * nq :]
+        else:
+            out_refs = refs[:nq]
             zout_refs = None
-            rings = refs[2 * nq :]
+            rings = refs[nq :]
         i = pl.program_id(0)
-        vals = [ref[0] for ref in in_refs]  # level-0 raw plane i per quantity
+        # level-0 raw plane i per quantity (upcast once under f32_accumulate)
+        vals = [up(ref[0]) for ref in in_refs]
         y_g, z_g = _yz_coord_planes(origin_ref, Yr, Zr, s_off, s_off, gsize)
         if z_slabs is not None:
             # patch the z-shell columns in VMEM — never stored in the big
             # array (see jacobi_shell_wavefront_step)
             col = lax.broadcasted_iota(jnp.int32, (Yr, Zr), 1)
             for q in range(nq):
-                zst = jnp.swapaxes(zs_refs[q][0], 0, 1)  # (Yr, 2s)
+                zst = up(jnp.swapaxes(zs_refs[q][0], 0, 1))  # (Yr, 2s)
                 v = vals[q]
                 for j in range(s_off):
                     v = jnp.where(col == j, zst[:, j][:, None], v)
@@ -346,7 +418,8 @@ def stream_wavefront_pass(
             for q in range(nq):
                 rings[q][s - 1, i % 2] = vals[q]  # push plane i-s+1
             views = {
-                names[q]: PlaneView((prevs[q], cents[q], vals[q]), roll)
+                names[q]: PlaneView((prevs[q], cents[q], vals[q]), roll,
+                                    bands=bands)
                 for q in range(nq)
             }
             x_g = lax.rem(
@@ -362,7 +435,8 @@ def stream_wavefront_pass(
                 for q in range(nq)
             ]
         for q in range(nq):
-            out_refs[q][0] = vals[q]  # level-m plane i-m
+            # level-m plane i-m (the one f32_accumulate downcast)
+            out_refs[q][0] = vals[q].astype(raws[q].dtype)
             if zout_refs is not None:
                 emit = jnp.concatenate(
                     [
@@ -370,7 +444,7 @@ def stream_wavefront_pass(
                         vals[q][:, s_off : 2 * s_off],
                     ],
                     axis=1,
-                )  # (Yr, 2s)
+                ).astype(raws[q].dtype)  # (Yr, 2s)
                 zout_refs[q][0] = jnp.swapaxes(emit, 0, 1)
 
     out_idx = lambda i: (jnp.maximum(i - m, 0), 0, 0)
@@ -382,6 +456,12 @@ def stream_wavefront_pass(
         jax.ShapeDtypeStruct((Xr, Yr, Zr), b.dtype) for b in raws
     ]
     args = [origin.astype(jnp.int32), *raws]
+    if mxu:
+        in_specs += [
+            pl.BlockSpec((Yr, Yr), lambda i: (0, 0)),
+            pl.BlockSpec((Zr, Zr), lambda i: (0, 0)),
+        ]
+        args += [band_matrix(Yr), band_matrix(Zr)]
     if z_slabs is not None:
         for q in range(nq):
             assert z_slabs[q].shape == (Xr, 2 * s_off, Yr), z_slabs[q].shape
@@ -395,7 +475,9 @@ def stream_wavefront_pass(
         ]
         args += list(z_slabs)
     # in-place safe (write trails read by m+1 planes); un-aliased is ~20%
-    # faster at deep m (probe21b) at the cost of fresh output buffers
+    # faster at deep m (probe21b) at the cost of fresh output buffers.
+    # (Band-matrix inputs sit between the raws and the slabs, so the alias
+    # map stays raw-q -> out-q regardless.)
     aliases = {1 + q: q for q in range(nq)} if alias else {}
     outs = pl.pallas_call(
         body,
@@ -405,7 +487,7 @@ def stream_wavefront_pass(
         out_shape=tuple(out_shape),
         input_output_aliases=aliases,
         scratch_shapes=[
-            pltpu.VMEM((m, 2, Yr, Zr), b.dtype) for b in raws
+            pltpu.VMEM((m, 2, Yr, Zr), acc) for acc in acc_dtypes
         ],
         interpret=interpret,
         **_tpu_compiler_params(interpret),
@@ -424,6 +506,10 @@ def stream_wrap_pass(
     origin: jax.Array,  # (3,) int32 — global coords of the block start
     global_size: Dim3,
     interpret: bool = False,
+    compute_unit: str = "vpu",  # "mxu": resident band matrices + contraction
+    # via the views' plane_nbr_sum (see stream_plane_pass)
+    f32_accumulate: bool = False,  # bf16-storage variant (see
+    # stream_wavefront_pass)
 ) -> List[jax.Array]:
     """``k`` kernel levels over the WHOLE (single-device) domain with the
     periodic wrap folded in — the user-kernel generalization of
@@ -439,13 +525,24 @@ def stream_wrap_pass(
     assert 1 <= k <= X // 2, (k, X)
     roll = _make_roll(interpret)
     gsize = global_size
+    mxu = compute_unit == "mxu"
+    acc_dtypes = [
+        jnp.float32 if f32_accumulate else b.dtype for b in blocks
+    ]
+    up = (lambda v: v.astype(jnp.float32)) if f32_accumulate else (lambda v: v)
 
     def body(origin_ref, *refs):
         in_refs = refs[:nq]
-        out_refs = refs[nq : 2 * nq]
-        rings = refs[2 * nq :]
+        refs = refs[nq:]
+        if mxu:
+            bands = (refs[0][...], refs[1][...])
+            refs = refs[2:]
+        else:
+            bands = None
+        out_refs = refs[:nq]
+        rings = refs[nq:]
         i = pl.program_id(0)
-        vals = [ref[0] for ref in in_refs]  # level-0 plane i (mod X)
+        vals = [up(ref[0]) for ref in in_refs]  # level-0 plane i (mod X)
         y_g, z_g = _yz_coord_planes(origin_ref, Y, Z, 0, 0, gsize)
         for s in range(1, k + 1):
             prevs = [rings[q][s - 1, i % 2] for q in range(nq)]
@@ -453,7 +550,8 @@ def stream_wrap_pass(
             for q in range(nq):
                 rings[q][s - 1, i % 2] = vals[q]
             views = {
-                names[q]: PlaneView((prevs[q], cents[q], vals[q]), roll)
+                names[q]: PlaneView((prevs[q], cents[q], vals[q]), roll,
+                                    bands=bands)
                 for q in range(nq)
             }
             x_g = lax.rem(
@@ -469,13 +567,23 @@ def stream_wrap_pass(
                 for q in range(nq)
             ]
         for q in range(nq):
-            out_refs[q][0] = vals[q]  # level-k plane (i - k) % X
+            # level-k plane (i - k) % X (the one f32_accumulate downcast)
+            out_refs[q][0] = vals[q].astype(blocks[q].dtype)
 
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + [
+        pl.BlockSpec((1, Y, Z), lambda i: (i % X, 0, 0)) for _ in range(nq)
+    ]
+    args = [origin.astype(jnp.int32), *blocks]
+    if mxu:
+        in_specs += [
+            pl.BlockSpec((Y, Y), lambda i: (0, 0)),
+            pl.BlockSpec((Z, Z), lambda i: (0, 0)),
+        ]
+        args += [band_matrix(Y), band_matrix(Z)]
     outs = pl.pallas_call(
         body,
         grid=(X + 2 * k,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
-        + [pl.BlockSpec((1, Y, Z), lambda i: (i % X, 0, 0)) for _ in range(nq)],
+        in_specs=in_specs,
         out_specs=tuple(
             pl.BlockSpec((1, Y, Z), lambda i: ((i - k) % X, 0, 0))
             for _ in range(nq)
@@ -483,26 +591,32 @@ def stream_wrap_pass(
         out_shape=tuple(
             jax.ShapeDtypeStruct((X, Y, Z), b.dtype) for b in blocks
         ),
-        scratch_shapes=[pltpu.VMEM((k, 2, Y, Z), b.dtype) for b in blocks],
+        scratch_shapes=[pltpu.VMEM((k, 2, Y, Z), acc) for acc in acc_dtypes],
         interpret=interpret,
         **_tpu_compiler_params(interpret),
-    )(origin.astype(jnp.int32), *blocks)
+    )(*args)
     # out_shape is always a tuple, so pallas returns a tuple even for nq=1
     return list(outs)
 
 
 def stream_vmem_fits(
-    m: int, plane_y: int, plane_z: int, itemsizes: Sequence[int], z_slabs: bool
+    m: int, plane_y: int, plane_z: int, itemsizes: Sequence[int], z_slabs: bool,
+    ring_itemsizes: Sequence[int] = None,
 ) -> bool:
     """VMEM model of the generic wavefront: per quantity, 2m ring planes +
     4 pipeline planes (+ 4 z-slab blocks), plus a PER-QUANTITY stack margin —
     the level loop holds each field's roll/select temporaries live at once
     (measured: 8-field m=2 at 518x640 planes reported 108.6 MB against an
     85 MB block model, ~2.6 MB of stack per field).  Same padded-bytes
-    accounting as ``wavefront_vmem_bytes``."""
+    accounting as ``wavefront_vmem_bytes``.  ``ring_itemsizes`` overrides
+    the ring planes' itemsizes: bf16 STORAGE streams 2-byte pipeline planes
+    but carries its level rings at f32 (the ``f32_accumulate`` contract),
+    so the rings must be modeled at the NATIVE itemsize or the gate lies."""
+    ring = itemsizes if ring_itemsizes is None else ring_itemsizes
     est = 0
-    for it in itemsizes:
-        est += (2 * m + 4) * _padded_plane_bytes(plane_y, plane_z, it)
+    for it, rit in zip(itemsizes, ring):
+        est += 2 * m * _padded_plane_bytes(plane_y, plane_z, rit)
+        est += 4 * _padded_plane_bytes(plane_y, plane_z, it)
         if z_slabs:
             est += 4 * _padded_plane_bytes(2 * m, plane_y, it)
     return est + _VMEM_STACK_MARGIN * len(itemsizes) <= _vmem_budget()
@@ -536,6 +650,10 @@ def _tuned_stream_plan(dd, x_radius: int, separable: bool) -> dict:
     # never a crash), like any other hand-edited field.
     if cfg.get("overlap") is not None:
         plan["overlap"] = cfg["overlap"]
+    # the compute-unit axis rides the same no-schema-bump rule: absent =
+    # the static vpu, garbage invalidates the plan below
+    if cfg.get("compute_unit") is not None:
+        plan["compute_unit"] = cfg["compute_unit"]
     n = dd.local_spec().sz
     shell = dd._shell_radius
     lo, hi = shell.lo(), shell.hi()
@@ -543,6 +661,8 @@ def _tuned_stream_plan(dd, x_radius: int, separable: bool) -> dict:
     ok = isinstance(m, int) and m >= 1
     if ok and plan.get("overlap") is not None:
         ok = plan["overlap"] in STREAM_OVERLAP
+    if ok and plan.get("compute_unit") is not None:
+        ok = plan["compute_unit"] in COMPUTE_UNITS
     if ok and plan["grouping"] == "per-field":
         ok = separable and len(dd._handles) > 1
     elif ok and plan["grouping"] != "joint":
@@ -641,7 +761,10 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
         )
     uniform = len({lo.x, lo.y, lo.z, hi.x, hi.y, hi.z}) == 1
     s = lo.x
-    itemsizes = [h.dtype.itemsize for h in dd._handles]
+    # pipeline planes stream at the STORAGE itemsize; the level rings carry
+    # the f32_accumulate working precision, i.e. the native itemsize
+    itemsizes = [dd.field_dtype(h).itemsize for h in dd._handles]
+    ring_sizes = [h.dtype.itemsize for h in dd._handles]
     # single device: the WRAP route folds the periodic boundary into the
     # kernel's index maps/rotates — no shell reads, no exchange, the deepest
     # temporal blocking (the user-kernel analog of jacobi_wrap_step)
@@ -650,13 +773,17 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
         if max_m is not None:
             cap = min(cap, max_m)
         best = None
-        for grouping, sizes in (
-            [("joint", itemsizes)]
-            + ([("per-field", [max(itemsizes)])] if separable and len(itemsizes) > 1 else [])
+        for grouping, sizes, rsizes in (
+            [("joint", itemsizes, ring_sizes)]
+            + (
+                [("per-field", [max(itemsizes)], [max(ring_sizes)])]
+                if separable and len(itemsizes) > 1
+                else []
+            )
         ):
             k = 0
             for cand in range(1, cap + 1):
-                if stream_vmem_fits(cand, n.y, n.z, sizes, False):
+                if stream_vmem_fits(cand, n.y, n.z, sizes, False, rsizes):
                     k = cand
             # deepest k across groupings — depth is the traffic lever
             # (~8/k B/cell/iter); joint wins ties
@@ -690,17 +817,19 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
         # separable kernels, then take the DEEPEST m — depth is the traffic
         # lever (~8/m B/cell/iter); grouping only changes VMEM pressure and
         # per-pass ramp overhead, so joint wins ties
-        group_options = [("joint", itemsizes)]
+        group_options = [("joint", itemsizes, ring_sizes)]
         if separable and len(itemsizes) > 1:
-            group_options.append(("per-field", [max(itemsizes)]))
+            group_options.append(
+                ("per-field", [max(itemsizes)], [max(ring_sizes)])
+            )
         best = None
         # z-slab form's static emit slices assume even shards
         z_modes = ((False, raw.z),) if padded else ((True, zp), (False, raw.z))
-        for grouping, sizes in group_options:
+        for grouping, sizes, rsizes in group_options:
             for z_mode, plane_z in z_modes:
                 m = 0 if z_mode else 1
                 for cand in range(2, cap + 1):
-                    if stream_vmem_fits(cand, raw.y, plane_z, sizes, z_mode):
+                    if stream_vmem_fits(cand, raw.y, plane_z, sizes, z_mode, rsizes):
                         m = cand
                 if m >= 2 and (best is None or m > best["m"]):
                     best = {
@@ -725,7 +854,11 @@ def plan_stream(dd, x_radius: int, path: str = "auto", separable: bool = False,
         )
     raw = dd.local_spec().raw_size()
     grouping = "joint"
-    if not stream_vmem_fits(x_radius, raw.y, raw.z, itemsizes, False):
+    # the PLANE pass's ring scratch holds RAW (storage-dtype) planes —
+    # stream_plane_pass upcasts transiently at view construction, never in
+    # the ring — so its gate models rings at the STORAGE itemsize, unlike
+    # the wavefront/wrap passes whose rings carry the f32 accumulator
+    if not stream_vmem_fits(x_radius, raw.y, raw.z, itemsizes, False, itemsizes):
         # (2r+4) resident planes per field blow the budget jointly
         if separable and len(itemsizes) > 1:
             grouping = "per-field"
@@ -890,18 +1023,17 @@ def plain_wavefront_plan(dd, plan: dict, max_depth: Optional[int] = None) -> Opt
     shell = dd._shell_radius
     s = shell.lo().x
     raw = dd.local_spec().raw_size()
-    itemsizes = [h.dtype.itemsize for h in dd._handles]
-    sizes = (
-        [max(itemsizes)]
-        if plan.get("grouping") == "per-field" and len(itemsizes) > 1
-        else itemsizes
-    )
+    itemsizes = [dd.field_dtype(h).itemsize for h in dd._handles]
+    ring_sizes = [h.dtype.itemsize for h in dd._handles]
+    per_field = plan.get("grouping") == "per-field" and len(itemsizes) > 1
+    sizes = [max(itemsizes)] if per_field else itemsizes
+    rsizes = [max(ring_sizes)] if per_field else ring_sizes
     cap = min(s, _WRAP_MAX_K)
     if max_depth is not None:
         cap = min(cap, max_depth)
     m = 0
     for cand in range(2, cap + 1):
-        if stream_vmem_fits(cand, raw.y, raw.z, sizes, False):
+        if stream_vmem_fits(cand, raw.y, raw.z, sizes, False, rsizes):
             m = cand
     if m < 2:
         return None
@@ -911,7 +1043,8 @@ def plain_wavefront_plan(dd, plan: dict, max_depth: Optional[int] = None) -> Opt
     return out
 
 
-def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
+def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True,
+                       mxu_kernel=None):
     from jax.sharding import PartitionSpec as P
 
     from stencil_tpu.ops.exchange import halo_exchange_multi
@@ -958,6 +1091,36 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
         m=plan["m"],
     )
     split = overlap == "split"
+    # compute-unit axis (ops/jacobi_pallas COMPUTE_UNITS): shared precedence
+    # chain (forced plan value = explicit requests / autotuner candidates /
+    # ladder step-downs > STENCIL_COMPUTE_UNIT > tuned plan > static vpu)
+    # plus the stream engine's structural gate — mxu needs a DECLARED
+    # axis-separable contraction form (``mxu_kernel``; opaque user kernels
+    # have none and degrade with a warning) and f32 compute dtypes.  bf16
+    # STORAGE (``f32_accumulate``) computes at the native f32 and qualifies.
+    f32_acc = any(dd.field_dtype(h) != h.dtype for h in dd._handles)
+    unit_req = (
+        plan.get("compute_unit") if plan.get("compute_unit_forced") else None
+    )
+    unit_tuned = None if unit_req is not None else plan.get("compute_unit")
+    compute_unit, _unit_src = resolve_compute_unit(
+        unit_req,
+        unit_tuned,
+        [h.dtype for h in dd._handles],
+        where=f"stream:{plan['route']}",
+        engine_ok=mxu_kernel is not None,
+        engine_why=(
+            "the kernel declares no axis-separable contraction form "
+            "(make_stream_step mxu_kernel=...)"
+        ),
+    )
+    plan["compute_unit"] = compute_unit
+    if compute_unit == "mxu":
+        # the mxu form is the SAME stencil written through the views'
+        # plane_nbr_sum seam; every pass (interior, exterior bands, wrap)
+        # runs it, so the split-schedule bitwise argument holds per unit
+        kernel = mxu_kernel
+    unit_kw = {"compute_unit": compute_unit, "f32_accumulate": f32_acc}
 
     if split:
         from stencil_tpu.ops import halo_blend
@@ -1083,7 +1246,7 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
                 for g in groups:
                     outs = stream_wrap_pass(
                         kernel, [names[q] for q in g], [bs[q] for q in g],
-                        depth, origin, gsize, interpret=interpret,
+                        depth, origin, gsize, interpret=interpret, **unit_kw,
                     )
                     for q, o in zip(g, outs):
                         out[q] = o
@@ -1107,6 +1270,7 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
                 outs = stream_plane_pass(
                     kernel, [names[q] for q in g], [bs[q] for q in g],
                     lo, hi, x_radius, origin, gsize, interpret=interpret,
+                    **unit_kw,
                 )
                 for q, o in zip(g, outs):
                     out[q] = o
@@ -1132,7 +1296,7 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
                     outs = stream_plane_pass(
                         kernel, [names[q] for q in g], [subs[q] for q in g],
                         lo2, hi2, x_radius, origin_sub, gsize,
-                        interpret=interpret,
+                        interpret=interpret, **unit_kw,
                     )
                     for q, o in zip(g, outs):
                         out[q] = o
@@ -1195,6 +1359,7 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
                     z_valid=Zr if zs is not None else None,
                     alias=alias,
                     interpret=interpret,
+                    **unit_kw,
                 )
                 for j, q in enumerate(g):
                     outs[q] = o[j]
@@ -1219,6 +1384,7 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True):
                 o, _ = stream_wavefront_pass(
                     kernel, [names[q] for q in g], [subs[q] for q in g],
                     w, w, origin_sub, gsize, alias=False, interpret=interpret,
+                    **unit_kw,
                 )
                 for q, oo in zip(g, o):
                     out[q] = oo
@@ -1323,6 +1489,8 @@ def make_stream_step(
     donate: bool = True,
     max_depth: int = None,
     overlap: str = "auto",
+    compute_unit: str = "auto",
+    mxu_kernel: PlaneKernel = None,
 ):
     """Build a ``step(curr, steps) -> curr`` running ``kernel`` under the
     plane-streaming engine — the fast-by-default path for user stencils
@@ -1350,6 +1518,18 @@ def make_stream_step(
     ``off`` on every valid cell; a route it cannot serve (wrap, z-slab
     wavefront) degrades to ``off`` with a warning, and a compile-rejected
     split build steps down to ``off`` at the same depth through the ladder
+    before any depth descent.
+
+    ``compute_unit`` selects the level kernels' execution unit (a tuner
+    axis — docs/tuning.md "Compute unit and storage dtype"): ``"auto"``
+    resolves ``STENCIL_COMPUTE_UNIT`` > the tuned config > the static
+    ``vpu``; ``"mxu"`` routes the separable in-plane taps through one
+    banded contraction per axis on the matrix unit, which requires the
+    kernel's declared contraction form ``mxu_kernel`` — the SAME stencil
+    written against ``PlaneView.plane_nbr_sum`` (pinned ≤1 ulp/level
+    against the vpu form).  A kernel with no mxu form, or non-f32 compute
+    dtypes, degrades to ``vpu`` with a warning; a compile-rejected mxu
+    build steps down to ``vpu`` at the same depth through the ladder
     before any depth descent.
 
     The returned step rides the resilience DEGRADATION LADDER
@@ -1386,11 +1566,20 @@ def make_stream_step(
             f"unknown stream overlap {overlap!r} (one of "
             f"{('auto',) + STREAM_OVERLAP})"
         )
+    if compute_unit not in ("auto",) + COMPUTE_UNITS:
+        raise ValueError(
+            f"unknown compute unit {compute_unit!r} (one of "
+            f"{('auto',) + COMPUTE_UNITS})"
+        )
     plan = plan_stream(dd, x_radius, path, separable, max_m=max_depth)
-    if overlap != "auto":
+    if overlap != "auto" or compute_unit != "auto":
         plan = dict(plan)
+    if overlap != "auto":
         plan["overlap"] = overlap
         plan["overlap_forced"] = True
+    if compute_unit != "auto":
+        plan["compute_unit"] = compute_unit
+        plan["compute_unit_forced"] = True
     # a split request (explicit/env/tuned) against a z-slab wavefront plan
     # re-plans to the PLAIN form when it fits: split needs z halos in the
     # big array for the exchange it overlaps, and the packed zpack_* routes
@@ -1401,13 +1590,38 @@ def make_stream_step(
         if plain is not None:
             plan = plain
 
+    from stencil_tpu.ops.jacobi_pallas import mxu_supported
+
+    def _prospective_unit(p) -> str:
+        """The unit the build WILL resolve (same chain as
+        _build_stream_step, emit=False) — rung names must show an
+        env/tuned-sourced mxu, not just an explicit one.  Skipped when mxu
+        cannot engage (no declared form / non-f32), where the build's own
+        resolve owns the single degrade warning."""
+        if mxu_kernel is None or not mxu_supported(
+            [h.dtype for h in dd._handles]
+        ):
+            return "vpu"
+        u_req = p.get("compute_unit") if p.get("compute_unit_forced") else None
+        u_tuned = None if u_req is not None else p.get("compute_unit")
+        unit, _ = resolve_compute_unit(
+            u_req, u_tuned, [h.dtype for h in dd._handles],
+            where=f"stream:{p['route']}", emit=False,
+        )
+        return unit
+
     def rung_for(p):
         # build() resolves _build_stream_step through module globals at call
         # time, so tests may monkeypatch it
         suffix = ",split" if p.get("overlap") == "split" else ""
+        if _prospective_unit(p) == "mxu":
+            suffix += ",mxu"
         return Rung(
             name=f"{p['route']}[m={p['m']}{suffix}]",
-            build=lambda: _build_stream_step(dd, kernel, x_radius, p, interpret, donate),
+            build=lambda: _build_stream_step(
+                dd, kernel, x_radius, p, interpret, donate,
+                mxu_kernel=mxu_kernel,
+            ),
             state={"plan": p},
         )
 
@@ -1415,6 +1629,20 @@ def make_stream_step(
         plan_now = rung.state["plan"]
         from stencil_tpu.utils.logging import log_warn
 
+        if plan_now.get("compute_unit") == "mxu":
+            # first rung down: drop the MXU contraction form at the SAME
+            # depth/schedule — the band matmuls carry their own resident
+            # constants and matrix-unit lowering, so a VMEM_OOM or compile
+            # reject may be the contraction's fault, not the depth's
+            log_warn(
+                f"compute_unit=mxu on {plan_now['route']}[m={plan_now['m']}] "
+                f"exceeded the compiler's capability ({cls.value}); stepping "
+                "down to vpu at the same depth"
+            )
+            p2 = dict(plan_now)
+            p2["compute_unit"] = "vpu"
+            p2["compute_unit_forced"] = True
+            return rung_for(p2)
         if plan_now.get("overlap") == "split":
             # first rung down: drop the split schedule at the SAME depth —
             # the exterior passes carry their own scratch, so a VMEM_OOM or
@@ -1439,10 +1667,12 @@ def make_stream_step(
             "STENCIL_VMEM_LIMIT_BYTES)"
         )
         p2 = dict(plan_stream(dd, x_radius, path, separable, max_m=new_max))
-        # a descent never re-enables split: carry the (post-split-step-down)
-        # overlap state into the shallower plan as a forced value
+        # a descent never re-enables split or mxu: carry the (post-step-down)
+        # overlap/compute-unit state into the shallower plan as forced values
         p2["overlap"] = plan_now.get("overlap", "off")
         p2["overlap_forced"] = True
+        p2["compute_unit"] = plan_now.get("compute_unit", "vpu")
+        p2["compute_unit_forced"] = True
         return rung_for(p2)
 
     ladder = DegradationLadder(rung_for(plan), lower=lower, label="stream")
@@ -1452,6 +1682,11 @@ def make_stream_step(
     band_area = 2 * (raw.y * raw.z + raw.x * raw.z + raw.x * raw.y) * len(
         dd._handles
     ) * n_doms
+    # analytic MXU FLOPs of ONE raw iteration under the contraction form
+    # (all shards, all fields; modeled on raw plane dims, like band_area)
+    mxu_flops_iter = (
+        mxu_flops_per_plane(raw.y, raw.z) * raw.x * len(dd._handles) * n_doms
+    )
 
     def _exterior_cells(plan_now, steps: int) -> int:
         """Analytic cells recomputed by the exterior band passes for this
@@ -1471,6 +1706,8 @@ def make_stream_step(
         cells = _exterior_cells(plan_now, steps)
         if cells:
             telemetry.inc(tm.STEP_OVERLAP_EXTERIOR_CELLS, cells)
+        if plan_now.get("compute_unit") == "mxu":
+            telemetry.inc(tm.KERNEL_MXU_FLOPS, steps * mxu_flops_iter)
         return out
 
     step._marks_shell_stale = True

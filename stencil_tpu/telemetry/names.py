@@ -63,6 +63,11 @@ EXCHANGE_PACKED_KERNELS = "exchange.packed.kernels"
 #: surface work the overlapped schedule pays to free the interior pass from
 #: any ppermute dependency; 0 under ``overlap=off``
 STEP_OVERLAP_EXTERIOR_CELLS = "step.overlap.exterior_cells"
+#: analytic MXU FLOPs issued by the banded-contraction level kernels
+#: (``compute_unit=mxu`` — ops/jacobi_pallas.py ``mxu_flops_per_plane``):
+#: dense band-matmul FLOPs per level per plane, modeled once per build like
+#: the exchange bytes; 0 under ``compute_unit=vpu``
+KERNEL_MXU_FLOPS = "kernel.mxu.flops"
 
 ALL_COUNTERS = frozenset({
     EXCHANGE_COUNT,
@@ -83,6 +88,7 @@ ALL_COUNTERS = frozenset({
     TUNE_PRUNED,
     TUNE_SELECTED,
     STEP_OVERLAP_EXTERIOR_CELLS,
+    KERNEL_MXU_FLOPS,
 })
 
 # --- gauges (last-value) -----------------------------------------------------
@@ -167,6 +173,14 @@ EVENT_EXCHANGE_ROUTE = "exchange.route"
 #: overlap=off|split, source=explicit|env|tuned|static|ladder or
 #: "<orig>/degraded" on a structural step-down, route, m)
 EVENT_STEP_OVERLAP = "step.overlap"
+#: a kernel build resolved its compute-unit axis (fields: unit=vpu|mxu,
+#: source=explicit|env|tuned|static|ladder or "<orig>/degraded" when a
+#: structural guard stepped an mxu request down, where)
+EVENT_KERNEL_COMPUTE_UNIT = "kernel.compute_unit"
+#: a model build resolved its storage-dtype axis (fields:
+#: storage=native|bf16, source — same vocabulary as kernel.compute_unit,
+#: where)
+EVENT_KERNEL_STORAGE_DTYPE = "kernel.storage_dtype"
 
 ALL_EVENTS = frozenset({
     EVENT_COMPILE,
@@ -180,6 +194,8 @@ ALL_EVENTS = frozenset({
     EVENT_TUNE_TRIAL,
     EVENT_EXCHANGE_ROUTE,
     EVENT_STEP_OVERLAP,
+    EVENT_KERNEL_COMPUTE_UNIT,
+    EVENT_KERNEL_STORAGE_DTYPE,
 })
 
 #: every registered name, any kind — what the lint checks literals against

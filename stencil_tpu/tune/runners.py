@@ -62,17 +62,25 @@ def autotune_jacobi_wrap(
     with tune.disabled():
         static_k = choose_temporal_k((x, y, z), dtype.itemsize)
     candidates, prefiltered = space.jacobi_wrap_space(
-        (x, y, z), dtype.itemsize, static_k, ks=ks
+        (x, y, z), dtype.itemsize, static_k, ks=ks, dtype=dtype
     )
-    # the trial buffer allocates lazily at the FIRST candidate build: a
-    # warm-cache call must not touch device memory at all
+    # trial buffers allocate lazily at the FIRST candidate build needing
+    # them (one per storage dtype — the bf16 twin streams narrow planes):
+    # a warm-cache call must not touch device memory at all
     state = {}
 
     def build_run(cand):
-        if "block" not in state:
-            state["block"] = jnp.full((x, y, z), 0.5, dtype)
-        block = state["block"]
+        storage = cand.get("storage_dtype", "native")
+        unit = cand.get("compute_unit", "vpu")
+        bdt = jnp.bfloat16 if storage == "bf16" else dtype
+        if storage not in state:
+            state[storage] = jnp.full((x, y, z), 0.5, bdt)
+        block = state[storage]
         k = cand["k"]
+        kern_kw = {
+            "compute_unit": unit,
+            "f32_accumulate": storage == "bf16",
+        }
 
         @partial(jax.jit, static_argnums=1)
         def steps(b, n):
@@ -81,11 +89,13 @@ def autotune_jacobi_wrap(
                 b = lax.fori_loop(
                     0,
                     blocked,
-                    lambda _, bb: jacobi_wrap_step(bb, interpret=interpret, k=k),
+                    lambda _, bb: jacobi_wrap_step(
+                        bb, interpret=interpret, k=k, **kern_kw
+                    ),
                     b,
                 )
             if rem:
-                b = jacobi_wrap_step(b, interpret=interpret, k=rem)
+                b = jacobi_wrap_step(b, interpret=interpret, k=rem, **kern_kw)
             return b
 
         def run(n):
@@ -98,7 +108,11 @@ def autotune_jacobi_wrap(
         candidates,
         build_run,
         depth_key="k",
-        static={"k": static_k},
+        static={
+            "k": static_k,
+            "compute_unit": "vpu",
+            "storage_dtype": "native",
+        },
         reps=reps,
         rt=rt,
         prefiltered=prefiltered,
@@ -131,7 +145,8 @@ def autotune_jacobi_wavefront(
 
     dtype = jnp.dtype(dtype or jnp.float32)
 
-    def make_model(temporal_k="auto", alias=None, z_ring=None):
+    def make_model(temporal_k="auto", alias=None, z_ring=None,
+                   compute_unit=None, storage_dtype=None):
         kwargs = {} if strategy is None else {"strategy": strategy}
         return Jacobi3D(
             x,
@@ -145,6 +160,8 @@ def autotune_jacobi_wavefront(
             interpret=interpret,
             wavefront_alias=alias,
             z_ring=z_ring,
+            compute_unit=compute_unit,
+            storage_dtype=storage_dtype,
             **kwargs,
         )
 
@@ -158,6 +175,8 @@ def autotune_jacobi_wavefront(
         getattr(probe, "_wavefront_z_planned", False)
         and info["n"][2] % 128 == 0
     )
+    from stencil_tpu.ops.jacobi_pallas import bf16_supported, mxu_supported
+
     candidates, prefiltered = space.jacobi_wavefront_space(
         static_m,
         # structural caps only (a shard must fill an m-wide halo from valid
@@ -168,12 +187,16 @@ def autotune_jacobi_wavefront(
         z_ring_eligible=z_ring_eligible,
         static_z_ring=True,
         ms=ms,
+        mxu_ok=mxu_supported([dtype]),
+        bf16_ok=bf16_supported([dtype]),
     )
     models = {}
 
     def build_run(cand):
         model = make_model(
-            temporal_k=cand["m"], alias=cand["alias"], z_ring=cand.get("z_ring")
+            temporal_k=cand["m"], alias=cand["alias"], z_ring=cand.get("z_ring"),
+            compute_unit=cand.get("compute_unit"),
+            storage_dtype=cand.get("storage_dtype"),
         )
         model.realize()
         models[space.candidate_label(cand)] = model  # keep resident
@@ -194,6 +217,8 @@ def autotune_jacobi_wavefront(
             "halo_multiplier": static_m,
             "alias": False,
             "z_ring": z_ring_eligible,
+            "compute_unit": "vpu",
+            "storage_dtype": "native",
         },
         reps=reps,
         rt=rt,
@@ -263,18 +288,29 @@ def autotune_stream(
     interpret: bool = False,
     reps: int = 3,
     rt: Optional[float] = None,
+    mxu_kernel=None,
 ) -> TuneReport:
-    """Tune the generic stream engine's plan (route, depth, alias, overlap)
-    for a REALIZED domain + user kernel.  Trials run non-donating steps over the
+    """Tune the generic stream engine's plan (route, depth, alias, overlap,
+    compute unit) for a REALIZED domain + user kernel.  Trials run
+    non-donating steps over the
     domain's live buffers (the domain state is never advanced), so the
     tuned plan feeds the very next ``make_step(engine="stream")`` on the
-    same process via the cache."""
+    same process via the cache.  ``mxu_kernel`` is the kernel's declared
+    contraction form — without it the compute-unit A/B is structurally
+    prefiltered (an mxu candidate could only degrade to vpu and measure a
+    duplicate)."""
+    from stencil_tpu.ops.jacobi_pallas import mxu_supported
     from stencil_tpu.ops.stream import _build_stream_step, plan_stream
 
     key = dd.tune_key("stream")
     with tune.disabled():
         static_plan = plan_stream(dd, x_radius, "auto", separable)
-    candidates, prefiltered = space.stream_space(dd, x_radius, separable, static_plan)
+    mxu_ok = mxu_kernel is not None and mxu_supported(
+        [h.dtype for h in dd._handles]
+    )
+    candidates, prefiltered = space.stream_space(
+        dd, x_radius, separable, static_plan, mxu_ok=mxu_ok
+    )
 
     def build_run(cand):
         plan = dict(cand)
@@ -288,7 +324,13 @@ def autotune_stream(
             # same for the overlap A/B under STENCIL_STREAM_OVERLAP: the
             # off and split candidates must build their own schedules
             plan["overlap_forced"] = True
-        step = _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=False)
+        if "compute_unit" in plan:
+            # and for the compute-unit A/B under STENCIL_COMPUTE_UNIT
+            plan["compute_unit_forced"] = True
+        step = _build_stream_step(
+            dd, kernel, x_radius, plan, interpret, donate=False,
+            mxu_kernel=mxu_kernel,
+        )
 
         def run(n):
             out = step(dd._curr, n)
@@ -299,6 +341,7 @@ def autotune_stream(
     static = dict(static_plan)
     static.setdefault("halo_multiplier", static.get("m", 1))
     static.setdefault("overlap", "off")
+    static.setdefault("compute_unit", "vpu")
     return tune.ensure(
         key,
         candidates,

@@ -22,6 +22,16 @@ Axes (ISSUE: the constants PERF_NOTES.md says to re-qualify per chip):
   z-shell message (ops/exchange.py EXCHANGE_ROUTES); ``direct`` is the
   static fallback, the packed routes attack THE measured cost driver of
   shell-carrying halo storage (PERF_NOTES "Thin z-region access").
+* **compute unit** (vpu/mxu) — the level kernels' execution unit
+  (ops/jacobi_pallas ``COMPUTE_UNITS``): the roll+add chain on the vector
+  lanes vs one banded contraction per in-plane axis on the matrix unit —
+  the "Break the VPU wall" lever (PERF_NOTES "VPU wall": the k≈12-24
+  plateau is roll+add-bound, not DMA).  ``vpu`` is the static fallback;
+  mxu candidates are structurally prefiltered to f32-compute plans.
+* **storage dtype** (native/bf16) — bf16 field buffers with f32
+  accumulation in-kernel, halving bytes/cell on the DMA-bound shallow-k
+  paths; ``native`` is the static fallback, bf16 prefiltered to f32
+  fields (the only narrowing with an analytic error contract).
 * **halo multiplier** — for the temporally-blocked paths the multiplier IS
   the wavefront depth (the m-wide shell is exchanged every m steps), so the
   ``m`` axis covers it; candidate dicts carry ``halo_multiplier == m`` to
@@ -95,11 +105,26 @@ def jacobi_wrap_space(
     itemsize: int,
     static_k: int,
     ks=None,
+    dtype=None,
 ) -> Tuple[List[dict], int]:
     """(candidates, prefiltered_count) over the wrap kernel's temporal depth
-    ``k``.  ``ks`` overrides the grid (tests / narrow re-qualification)."""
-    from stencil_tpu.ops.jacobi_pallas import wavefront_vmem_fits
+    ``k`` plus, at the static depth, the compute-unit and storage-dtype
+    A/Bs (one twin each, like the wavefront space's z-ring pair — the axes
+    are independent of depth to first order, so one pair per search
+    re-qualifies them cheaply).  Structural prefilters: ``mxu`` only for
+    f32-compute plans, ``bf16`` only for f32 fields — filtered twins count
+    into ``tune.pruned`` without burning a trial.  ``ks`` overrides the
+    depth grid (tests / narrow re-qualification); ``dtype`` (default f32)
+    drives the axis prefilters."""
+    import jax.numpy as jnp
 
+    from stencil_tpu.ops.jacobi_pallas import (
+        bf16_supported,
+        mxu_supported,
+        wavefront_vmem_fits,
+    )
+
+    dtype = jnp.dtype(dtype or jnp.float32)
     X, Y, Z = shape
     grid = sorted({static_k, *(ks if ks is not None else _DEPTH_GRID)})
     grid = [k for k in grid if 1 <= k <= max(1, X // 2)]
@@ -108,9 +133,34 @@ def jacobi_wrap_space(
         # the static pick always runs (it IS the fallback being defended);
         # other depths must pass the VMEM model to be worth a compile
         if k == static_k or wavefront_vmem_fits(k, Y, Z, itemsize):
-            kept.append({"k": k})
+            kept.append(
+                {"k": k, "compute_unit": "vpu", "storage_dtype": "native"}
+            )
         else:
             prefiltered += 1
+    # the axis A/Bs at the static depth (persisted winners carry the axes
+    # explicitly; pre-axis cache entries without the fields stay warm —
+    # absent = the static vpu/native, no schema bump).  Unlike the static
+    # pick itself the twins are NOT the defended fallback, so they must
+    # pass the VMEM model — with mxu's resident band matrices / bf16's
+    # narrow pipeline planes over an f32 level ring folded in.
+    if mxu_supported([dtype]) and wavefront_vmem_fits(
+        static_k, Y, Z, itemsize, mxu=True
+    ):
+        kept.append(
+            {"k": static_k, "compute_unit": "mxu", "storage_dtype": "native"}
+        )
+    else:
+        prefiltered += 1
+    if bf16_supported([dtype]) and wavefront_vmem_fits(
+        static_k, Y, Z, jnp.dtype(jnp.bfloat16).itemsize,
+        ring_itemsize=itemsize,
+    ):
+        kept.append(
+            {"k": static_k, "compute_unit": "vpu", "storage_dtype": "bf16"}
+        )
+    else:
+        prefiltered += 1
     return kept, prefiltered
 
 
@@ -120,36 +170,48 @@ def jacobi_wavefront_space(
     z_ring_eligible: bool,
     static_z_ring: bool,
     ms=None,
+    mxu_ok: bool = False,
+    bf16_ok: bool = False,
 ) -> Tuple[List[dict], int]:
     """(candidates, prefiltered) over the multi-device wavefront: depth ``m``
     (== the halo multiplier: the m-wide shell is exchanged every m steps),
-    alias on/off, and — at the static depth — z-ring vs padded layout.
+    alias on/off, and — at the static depth — z-ring vs padded layout plus
+    the compute-unit / storage-dtype A/Bs (``mxu_ok`` / ``bf16_ok`` are the
+    structural prefilters the caller evaluates: f32 compute / f32 fields).
     ``depth_cap`` is the structural bound (shard/valid extents)."""
     grid = sorted({static_m, *(ms if ms is not None else _DEPTH_GRID)})
     grid = [m for m in grid if 1 <= m <= depth_cap]
     cands: List[dict] = []
+
+    def cand(m, alias, z_ring, unit="vpu", storage="native"):
+        return {
+            "m": m,
+            "halo_multiplier": m,
+            "alias": alias,
+            "z_ring": z_ring,
+            "compute_unit": unit,
+            "storage_dtype": storage,
+        }
+
     for m in grid:
         for alias in (False, True):
-            cands.append(
-                {
-                    "m": m,
-                    "halo_multiplier": m,
-                    "alias": alias,
-                    "z_ring": static_z_ring and z_ring_eligible,
-                }
-            )
+            cands.append(cand(m, alias, static_z_ring and z_ring_eligible))
     if z_ring_eligible:
         # the layout A/B at the static depth only: probe25d measured it
         # NEUTRAL on v5e, so one pair per search re-qualifies it cheaply
-        cands.append(
-            {
-                "m": static_m,
-                "halo_multiplier": static_m,
-                "alias": False,
-                "z_ring": not static_z_ring,
-            }
-        )
-    return cands, 0
+        cands.append(cand(static_m, False, not static_z_ring))
+    static_ring = static_z_ring and z_ring_eligible
+    prefiltered = 0
+    # the new-axis A/Bs at the static depth (one twin each, like z-ring)
+    if mxu_ok:
+        cands.append(cand(static_m, False, static_ring, unit="mxu"))
+    else:
+        prefiltered += 1
+    if bf16_ok:
+        cands.append(cand(static_m, False, static_ring, storage="bf16"))
+    else:
+        prefiltered += 1
+    return cands, prefiltered
 
 
 def exchange_space(dd) -> Tuple[List[dict], int]:
@@ -168,7 +230,9 @@ def exchange_space(dd) -> Tuple[List[dict], int]:
     packed_ok = (
         shell is not None
         and (shell.axis(2, -1) > 0 or shell.axis(2, +1) > 0)
-        and zpack_supported([h.dtype for h in dd._handles], dd._valid_last)
+        and zpack_supported(
+            [dd.field_dtype(h) for h in dd._handles], dd._valid_last
+        )
     )
     prefiltered = 0
     for route in EXCHANGE_ROUTES[1:]:
@@ -179,30 +243,37 @@ def exchange_space(dd) -> Tuple[List[dict], int]:
     return cands, prefiltered
 
 
-def stream_space(dd, x_radius: int, separable: bool, static_plan: dict) -> Tuple[List[dict], int]:
+def stream_space(dd, x_radius: int, separable: bool, static_plan: dict,
+                 mxu_ok: bool = False) -> Tuple[List[dict], int]:
     """(candidates, prefiltered) of full stream-engine plans around the
     static pick: the static plan, its shallower depths, the alias flip, the
-    plane route as the m=1 structural baseline, and the split-step overlap
+    plane route as the m=1 structural baseline, the split-step overlap
     A/B (``overlap ∈ {off, split}``, ops/stream.py — the interior pass
-    dispatched with no ppermute dependency).  Every candidate is a plan dict
-    ``_build_stream_step`` accepts verbatim (+ ``alias``/``overlap``).
+    dispatched with no ppermute dependency), and the compute-unit A/B
+    (``compute_unit ∈ {vpu, mxu}`` — the banded-contraction form; only when
+    ``mxu_ok``: the kernel declares an mxu form AND computes at f32).
+    Every candidate is a plan dict ``_build_stream_step`` accepts verbatim
+    (+ ``alias``/``overlap``/``compute_unit``).
 
-    Every candidate carries an explicit ``overlap`` field ("off" unless it
-    IS the split twin) so persisted winners record the axis — while v2-era
-    entries WITHOUT the field stay consultable (absent = the static off,
-    ops/stream.py ``_overlap_request``); no cache schema bump.  The split
-    twin of a z-slab wavefront re-plans to the plain form
+    Every candidate carries explicit ``overlap`` and ``compute_unit``
+    fields ("off"/"vpu" unless it IS that axis's twin) so persisted winners
+    record the axes — while older entries WITHOUT the fields stay
+    consultable (absent = the static off/vpu, ops/stream.py
+    ``_overlap_request`` / the compute-unit resolver); no cache schema
+    bump.  The split twin of a z-slab wavefront re-plans to the plain form
     (``plain_wavefront_plan``): split needs z halos in the big array for
     the exchange it overlaps."""
     from stencil_tpu.ops.stream import plain_wavefront_plan, plan_stream
 
     cands: List[dict] = []
 
-    def add(plan: dict, alias: Optional[bool], overlap: str = "off") -> None:
+    def add(plan: dict, alias: Optional[bool], overlap: str = "off",
+            unit: str = "vpu") -> None:
         c = dict(plan)
         if alias is not None:
             c["alias"] = alias
         c["overlap"] = overlap
+        c["compute_unit"] = unit
         c.setdefault("halo_multiplier", c.get("m", 1))
         if c not in cands:
             cands.append(c)
@@ -243,4 +314,19 @@ def stream_space(dd, x_radius: int, separable: bool, static_plan: dict) -> Tuple
     for base, alias_pick in split_bases:
         b = {k: v for k, v in base.items() if k not in ("overlap", "halo_multiplier")}
         add(b, alias_pick, overlap="split")
-    return cands, 0
+    # the compute-unit A/B: an mxu twin of the static plan, measured against
+    # its vpu sibling under the same protocol (the "Break the VPU wall"
+    # lever — the win depends on where the plan sits relative to the
+    # roll+add wall, so it is measured, not assumed)
+    prefiltered = 0
+    if mxu_ok:
+        b = {
+            k: v
+            for k, v in static_plan.items()
+            if k not in ("overlap", "halo_multiplier", "compute_unit")
+        }
+        add(b, static_alias if static_plan["route"] != "wrap" else None,
+            unit="mxu")
+    else:
+        prefiltered += 1
+    return cands, prefiltered

@@ -1,0 +1,27 @@
+# lint-fixture: select=accum-dtype rel=stencil_tpu/ops/fake.py expect=clean
+# The sanctioned pattern: every contraction in ops/ pins its accumulator
+# explicitly, so bf16 storage can never silently accumulate at bf16.
+import jax
+import jax.numpy as jnp
+
+DN = (((1,), (0,)), ((), ()))
+
+
+def band_contract(by, plane):
+    return jax.lax.dot_general(
+        by, plane, DN, preferred_element_type=jnp.float32
+    )
+
+
+def plain_dot(a, b):
+    return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+
+def host_numpy_is_out_of_scope(a, b):
+    import numpy as onp
+
+    return onp_dot(a, b)  # a helper, not a jax contraction
+
+
+def onp_dot(a, b):
+    return [x * y for x, y in zip(a, b)]

@@ -1,0 +1,28 @@
+# lint-fixture: select=accum-dtype rel=stencil_tpu/ops/fake.py expect=accum-dtype,accum-dtype,accum-dtype,bad-suppression
+# Seeded violations: contractions in ops/ without an explicit accumulator
+# fire (dot_general / jnp.dot / bare from-import form); a reasoned
+# suppression silences its site; a bare suppression fails AND leaves its
+# contraction flagged.
+import jax
+import jax.numpy as jnp
+from jax.lax import dot_general
+
+DN = (((1,), (0,)), ((), ()))
+
+
+def bad_band(by, plane):
+    return jax.lax.dot_general(by, plane, DN)
+
+
+def bad_dot(a, b):
+    return jnp.dot(a, b)
+
+
+# stencil-lint: disable=accum-dtype
+def bare_suppressed(a, b):
+    return dot_general(a, b, DN)
+
+
+def suppressed_ok(a, b):
+    # stencil-lint: disable=accum-dtype fixture: f32-only operands proven by the caller's gate
+    return jnp.matmul(a, b)

@@ -257,9 +257,9 @@ def test_ladder_steps_split_down_to_off(monkeypatch):
     real_build = sm._build_stream_step
     calls = []
 
-    def fake_build(dd, kernel, r, plan, interp, donate=True):
+    def fake_build(dd, kernel, r, plan, interp, donate=True, **kw):
         calls.append(dict(plan))
-        step = real_build(dd, kernel, r, plan, interp, donate)
+        step = real_build(dd, kernel, r, plan, interp, donate, **kw)
         if len(calls) == 1:
 
             def boom(curr, steps=1):

@@ -4,6 +4,8 @@ Astaroth proxy (radius-3 shell, distance-1 reads), even and uneven sizes."""
 import numpy as np
 import pytest
 
+from ulp import assert_reassociation_close
+
 from stencil_tpu.models.astaroth import AstarothSim
 
 
@@ -30,8 +32,11 @@ def test_astaroth_wavefront_schedule_matches_per_step():
     neighbor applies to the same level-(s-1) values, so skipping the
     intermediate exchanges changes nothing — up to the LAST ULP, which XLA
     may perturb by fusing the m levels into one graph (excess-precision /
-    reassociation across the division); hence tight-atol, not array_equal
-    (a depth-1 macro IS bitwise, see below)."""
+    reassociation across the division); hence the analytic reassociation
+    bound from tests/ulp.py, not array_equal (a depth-1 macro IS bitwise,
+    see below): ≤ 6 roundings per level may land in a different order /
+    excess precision, each contributing at most a half-ulp at the six-sum's
+    magnitude (≤ 6·|field|)."""
     a = AstarothSim(28, 28, 28, num_quantities=2, kernel_impl="pallas", interpret=True,
                     schedule="per-step")
     a.realize()
@@ -42,7 +47,10 @@ def test_astaroth_wavefront_schedule_matches_per_step():
     a.step(5)
     b.step(5)  # macros + a shallower remainder dispatch
     for i in range(2):
-        np.testing.assert_allclose(a.field(i), b.field(i), rtol=0, atol=1e-6)
+        assert_reassociation_close(
+            b.field(i), a.field(i), rounds=6 * 5, scale=6.0,
+            context=f"fused wavefront q{i}",
+        )
 
     # one step = a depth-1 remainder dispatch = the same exchange cadence:
     # near-identical (the engine's plane and wavefront passes evaluate the
@@ -86,3 +94,56 @@ def test_astaroth_wavefront_uneven_and_jnp_guard():
     # the temporal schedule needs the streaming engine
     with pytest.raises(ValueError, match="pallas"):
         AstarothSim(16, 16, 16, schedule="wavefront").realize()
+
+
+def test_mean6_kernel_axes_variants():
+    """The bespoke mean6 kernels' compute-unit / storage-dtype variants
+    (ISSUE 7): nothing in the shipped models calls these two directly (the
+    astaroth wavefront rides ops/stream.py), so pin the mxu and
+    f32-accumulate forms HERE against their vpu/native siblings or they
+    rot as the shared helpers (_make_level_sum, band_matrix) evolve."""
+    import jax.numpy as jnp
+
+    from ulp import assert_bf16_storage_close, assert_ulp_close
+
+    from stencil_tpu.core.dim3 import Dim3
+    from stencil_tpu.ops.plane_stencil import (
+        mean6_plane_step,
+        mean6_shell_wavefront_step,
+    )
+
+    rng = np.random.default_rng(11)
+    src = rng.random((16, 16, 16)).astype(np.float32)
+    # the wavefront kernel ALIASES its input (input_output_aliases={0: 0}),
+    # so every call gets its own device buffer
+    fresh = lambda dt=jnp.float32: jnp.asarray(src, dt)
+    raw = fresh()
+
+    # temporal wavefront: mxu ≤4 ulps/level; bf16 one downcast per pass.
+    # Only the interior is valid at level m (the shell carries garbage by
+    # the validity contract), so compare inside the shell_width=3 ring.
+    core = (slice(3, 13),) * 3
+    v = mean6_shell_wavefront_step(fresh(), m=2, shell_width=3, interpret=True)
+    m = mean6_shell_wavefront_step(fresh(), m=2, shell_width=3, interpret=True,
+                                   compute_unit="mxu")
+    assert_ulp_close(np.asarray(m)[core], np.asarray(v)[core], ulps=4 * 2,
+                     context="mean6 wavefront mxu")
+    b = mean6_shell_wavefront_step(fresh(jnp.bfloat16), m=2,
+                                   shell_width=3, interpret=True,
+                                   f32_accumulate=True)
+    assert b.dtype == jnp.bfloat16
+    assert_bf16_storage_close(np.asarray(b)[core], np.asarray(v)[core],
+                              passes=1, scale=1.0,
+                              context="mean6 wavefront bf16")
+
+    # single-level plane pass: same contracts (interior window only — the
+    # pass-through shell keeps its input bytes in every variant)
+    one = Dim3(1, 1, 1)
+    pv = mean6_plane_step(raw, one, one, interpret=True)
+    pm = mean6_plane_step(raw, one, one, interpret=True, compute_unit="mxu")
+    assert_ulp_close(np.asarray(pm), np.asarray(pv), ulps=4,
+                     context="mean6 plane mxu")
+    pb = mean6_plane_step(raw.astype(jnp.bfloat16), one, one, interpret=True,
+                          f32_accumulate=True)
+    assert_bf16_storage_close(pb, pv, passes=1, scale=1.0,
+                              context="mean6 plane bf16")

@@ -363,7 +363,7 @@ def test_stream_separable_per_field_grouping(monkeypatch):
     )
     assert step._stream_plan == {
         "route": "wavefront", "m": 3, "z_slabs": True, "grouping": "per-field",
-        "overlap": "off",
+        "overlap": "off", "compute_unit": "vpu",
     }
     monkeypatch.delenv("STENCIL_VMEM_LIMIT_BYTES")
     ref_dd, ref_hs = _mk(24, 24, 24, Radius.constant(1), names, devs)
@@ -385,7 +385,7 @@ def test_stream_runtime_vmem_fallback(monkeypatch):
     real_build = sm._build_stream_step
     calls = {"n": 0}
 
-    def fake_build(dd, kernel, r, plan, interp, donate=True):
+    def fake_build(dd, kernel, r, plan, interp, donate=True, **kw):
         calls["n"] += 1
         if calls["n"] == 1:
             assert plan["m"] == 3
@@ -397,7 +397,7 @@ def test_stream_runtime_vmem_fallback(monkeypatch):
                 )
 
             return boom
-        return real_build(dd, kernel, r, plan, interp, donate)
+        return real_build(dd, kernel, r, plan, interp, donate, **kw)
 
     monkeypatch.setattr(sm, "_build_stream_step", fake_build)
     devs = jax.devices()[:8]
@@ -429,7 +429,7 @@ def test_stream_depth_cap():
     )
     assert step._stream_plan == {
         "route": "wrap", "m": 8, "z_slabs": False, "grouping": "joint",
-        "overlap": "off",
+        "overlap": "off", "compute_unit": "vpu",
     }
     for a, b in outs:  # uncapped wrap vs the XLA ground truth
         np.testing.assert_allclose(a, b, **TOL)

@@ -220,7 +220,10 @@ def test_search_vmem_oom_prunes_deeper_wavefront_style_candidates():
         built.append((cand["m"], cand["alias"]))
         return lambda n: None
 
-    inject.set_plan("compile:vmem_oom:tune:synthetic:alias=0/halo_multiplier=8/m=8")
+    inject.set_plan(
+        "compile:vmem_oom:tune:synthetic:"
+        "alias=0/compute_unit=vpu/halo_multiplier=8/m=8"
+    )
     try:
         report = search(key, cands, build_run, depth_key="m", reps=1, rt=0.0)
     finally:
@@ -228,11 +231,12 @@ def test_search_vmem_oom_prunes_deeper_wavefront_style_candidates():
     # the alias=False m=8 OOM prunes alias=False m=12 untried; the alias=True
     # family is untouched
     assert (12, False) not in built
+    axes = {"compute_unit": "vpu", "storage_dtype": "native"}
     assert report.result_for(
-        {"m": 12, "halo_multiplier": 12, "alias": False, "z_ring": False}
+        {"m": 12, "halo_multiplier": 12, "alias": False, "z_ring": False, **axes}
     ).pruned
     assert not report.result_for(
-        {"m": 12, "halo_multiplier": 12, "alias": True, "z_ring": False}
+        {"m": 12, "halo_multiplier": 12, "alias": True, "z_ring": False, **axes}
     ).pruned
 
 
@@ -342,8 +346,11 @@ def test_forced_small_vmem_budget_prunes_deep_k(tune_dir, monkeypatch):
     report = autotune_jacobi_wrap(
         16, 16, 16, interpret=True, reps=1, ks=[1, 2, 4], rt=0.0
     )
-    # nothing beyond the static k=1 fits a 1-byte model budget
-    assert report.config == {"k": 1}
+    # nothing beyond the static k=1 fits a 1-byte model budget (the
+    # mxu/bf16 twins are VMEM-gated too; winners carry the axes explicitly)
+    assert report.config == {
+        "k": 1, "compute_unit": "vpu", "storage_dtype": "native"
+    }
     assert report.pruned >= 2
     assert _counter(tm.TUNE_PRUNED) >= p0 + 2
 

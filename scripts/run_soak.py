@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Kill/resume chaos soak: prove bitwise continuity across preemptions.
+
+The acceptance harness for the long-run survival layer
+(docs/resilience.md "Long-run operation"):
+
+1. A REFERENCE run of the jacobi3d driver completes ``--iters`` iterations
+   under the checkpoint supervisor, unkilled.  Its final ring checkpoint's
+   manifest carries a sha256 per quantity over the portable interiors —
+   the ground truth.
+2. A CHAOS run of the same workload is killed at ``--kills`` seeded points
+   (alternating SIGKILL — preemption without warning, no cleanup runs —
+   and SIGTERM — the polite notice the supervisor answers with a final
+   checkpoint and a resumable exit code 75), delivered from INSIDE the
+   process by the ``STENCIL_FAULT_PLAN`` process-kill hooks
+   (``dispatch:sigkill:jacobi@K`` — resilience/inject.py), so each kill
+   lands at a deterministic dispatch.  After each kill the driver is
+   relaunched with ``--resume``; the final relaunch runs to completion.
+3. The final manifests must match DIGEST-FOR-DIGEST: a resumed run's
+   fields are bitwise identical to the unkilled run's.
+
+``--dryrun`` forces the CPU backend with one fake device (like
+``run_weak_scaling.py``) so the whole chaos story runs on any machine;
+without it the driver uses the host's real devices.  A
+``soak_summary.json`` artifact records every kill, resume, and the final
+verdict.
+
+    python scripts/run_soak.py --dryrun
+
+The in-process tier-1 twin of this harness (one kill point, no
+subprocesses) is ``tests/test_supervisor.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+
+# runnable as `python scripts/run_soak.py` from anywhere: the manifest
+# readers import stencil_tpu (jax-free modules only) from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the supervisor's resumable exit (sysexits EX_TEMPFAIL)
+EXIT_RESUMABLE = 75
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "run_soak", description="kill/resume chaos soak (see module docstring)"
+    )
+    p.add_argument("--iters", type=int, default=24, help="total driver iterations")
+    p.add_argument(
+        "--checkpoint-every", type=int, default=4, help="supervisor step cadence"
+    )
+    p.add_argument("--keep", type=int, default=3, help="retention-ring size")
+    p.add_argument(
+        "--kills", type=int, default=3, help="seeded kill points (>= 3 for the chaos proof)"
+    )
+    p.add_argument("--seed", type=int, default=20260803, help="kill-point RNG seed")
+    p.add_argument(
+        "--size", nargs=3, type=int, default=[16, 16, 16], metavar=("X", "Y", "Z")
+    )
+    p.add_argument("--out-dir", default="soak_out", metavar="DIR")
+    p.add_argument(
+        "--max-launches",
+        type=int,
+        default=24,
+        help="safety valve on driver relaunches (a resume loop that stops "
+        "making progress must fail loudly, not spin)",
+    )
+    p.add_argument(
+        "--dryrun",
+        action="store_true",
+        help="CPU backend with 1 fake device — exercises the whole chaos "
+        "story anywhere (numbers are not perf)",
+    )
+    return p
+
+
+def driver_cmd(args, ckpt_dir: str, resume: bool) -> list:
+    cmd = [
+        sys.executable,
+        "-m",
+        "stencil_tpu.bin.jacobi3d",
+        *(str(v) for v in args.size),
+        "--no-weak-scale",
+        "--iters",
+        str(args.iters),
+        # the jnp engine exchanges every step and carries no cross-dispatch
+        # kernel state, so any dispatch partition of the same step count is
+        # bitwise identical — the property the digest comparison pins
+        "--kernel-impl",
+        "jnp",
+        "--checkpoint-dir",
+        ckpt_dir,
+        "--checkpoint-every",
+        str(args.checkpoint_every),
+        "--checkpoint-keep",
+        str(args.keep),
+    ]
+    if resume:
+        cmd.append("--resume")
+    return cmd
+
+
+def driver_env(args, fault_plan: str = "") -> dict:
+    env = dict(os.environ)
+    env.pop("STENCIL_FAULT_PLAN", None)
+    if fault_plan:
+        env["STENCIL_FAULT_PLAN"] = fault_plan
+    # npz checkpoints: the portable backend; also keeps subprocess launches
+    # free of the orbax import/save overhead the soak would pay per relaunch
+    env.setdefault("STENCIL_CHECKPOINT_BACKEND", "npz")
+    if args.dryrun:
+        flags = " ".join(
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=1").strip()
+        env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def launch(args, ckpt_dir: str, resume: bool, fault_plan: str = "") -> int:
+    cmd = driver_cmd(args, ckpt_dir, resume)
+    proc = subprocess.run(
+        cmd, env=driver_env(args, fault_plan), capture_output=True, text=True
+    )
+    if proc.returncode not in (0, EXIT_RESUMABLE) and not fault_plan:
+        print(proc.stdout, file=sys.stderr)
+        print(proc.stderr, file=sys.stderr)
+        raise SystemExit(f"unexpected driver failure rc={proc.returncode}")
+    return proc.returncode
+
+
+def final_manifest(ckpt_dir: str) -> dict:
+    from stencil_tpu.io.checkpoint import latest_valid
+
+    found = latest_valid(ckpt_dir)
+    if found is None:
+        raise SystemExit(f"no valid checkpoint under {ckpt_dir}")
+    return found[1]
+
+
+def ring_progress(ckpt_dir: str) -> int:
+    from stencil_tpu.io.checkpoint import ring_entries
+
+    entries = ring_entries(ckpt_dir)
+    return entries[-1][0] if entries else 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.iters < args.kills + 2:
+        raise SystemExit("--iters must leave room for every kill plus a resume")
+    os.makedirs(args.out_dir, exist_ok=True)
+    ref_dir = os.path.join(args.out_dir, "ref")
+    chaos_dir = os.path.join(args.out_dir, "chaos")
+    for d in (ref_dir, chaos_dir):
+        shutil.rmtree(d, ignore_errors=True)
+
+    print(f"== reference run: {args.iters} iters unkilled", file=sys.stderr)
+    rc = launch(args, ref_dir, resume=False)
+    if rc != 0:
+        raise SystemExit(f"reference run failed rc={rc}")
+    ref = final_manifest(ref_dir)
+    assert ref["step"] == args.iters, (ref["step"], args.iters)
+
+    rng = random.Random(args.seed)
+    kills = []
+    progress = 0
+    launches = 0
+    for i in range(args.kills):
+        # a seeded dispatch AHEAD of current progress, strictly before the
+        # end so there is always work left to resume; alternate the signal
+        # so BOTH preemption shapes are exercised every soak
+        remaining = args.iters - progress
+        offset = rng.randrange(0, max(remaining - 1, 1))
+        sig = "sigkill" if i % 2 == 0 else "sigterm"
+        plan = f"dispatch:{sig}:jacobi@{offset}"
+        print(
+            f"== chaos kill {i + 1}/{args.kills}: {sig} at dispatch "
+            f"{progress}+{offset} (plan {plan!r})",
+            file=sys.stderr,
+        )
+        rc = launch(args, chaos_dir, resume=i > 0, fault_plan=plan)
+        launches += 1
+        expected = EXIT_RESUMABLE if sig == "sigterm" else None
+        if rc == 0:
+            raise SystemExit(
+                f"kill {i + 1}: driver completed despite {plan!r} (rc=0)"
+            )
+        if expected is not None and rc != expected:
+            raise SystemExit(f"kill {i + 1}: sigterm run exited rc={rc}, want {expected}")
+        new_progress = ring_progress(chaos_dir)
+        kills.append(
+            {
+                "kill": i + 1,
+                "signal": sig,
+                "at_dispatch": progress + offset,
+                "rc": rc,
+                "checkpointed_step": new_progress,
+            }
+        )
+        progress = new_progress
+    # resume until clean completion (each resume may legitimately need a
+    # few launches only if something keeps failing — bound it)
+    while True:
+        print(f"== resume from step {progress}", file=sys.stderr)
+        rc = launch(args, chaos_dir, resume=True)
+        launches += 1
+        if rc == 0:
+            break
+        progress = ring_progress(chaos_dir)
+        if launches > args.max_launches:
+            raise SystemExit(f"no clean completion after {launches} launches")
+
+    chaos = final_manifest(chaos_dir)
+    ref_digests = {q["name"]: q["digest"] for q in ref["quantities"]}
+    chaos_digests = {q["name"]: q["digest"] for q in chaos["quantities"]}
+    identical = ref_digests == chaos_digests and chaos["step"] == ref["step"]
+
+    summary = {
+        "bench": "soak_kill_resume",
+        "dryrun": bool(args.dryrun),
+        "iters": args.iters,
+        "checkpoint_every": args.checkpoint_every,
+        "seed": args.seed,
+        "kills": kills,
+        "launches": launches,
+        "final_step": {"ref": ref["step"], "chaos": chaos["step"]},
+        "digests": {"ref": ref_digests, "chaos": chaos_digests},
+        "bitwise_identical": identical,
+    }
+    from stencil_tpu.utils.artifact import atomic_write_json
+
+    path = os.path.join(args.out_dir, "soak_summary.json")
+    atomic_write_json(path, summary)
+    print(json.dumps(summary))
+    if not identical:
+        print("FAIL: resumed fields differ from the unkilled run", file=sys.stderr)
+        return 1
+    print(
+        f"OK: {args.kills} kills, {launches} launches, fields bitwise "
+        f"identical to the unkilled run ({path})",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

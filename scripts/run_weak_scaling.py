@@ -34,6 +34,10 @@ import os
 import subprocess
 import sys
 
+# runnable as `python scripts/run_weak_scaling.py` from anywhere: the
+# atomic artifact writer imports stencil_tpu (jax-free) from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 DEFAULT_MESHES = ("2,1,1", "2,2,1", "2,2,2")
 
 
@@ -222,10 +226,10 @@ def main(argv=None) -> int:
             for doc in results
         ],
     }
+    from stencil_tpu.utils.artifact import atomic_write_json
+
     path = os.path.join(args.out_dir, "weak_scaling_summary.json")
-    with open(path, "w") as f:
-        json.dump(summary, f, indent=2, sort_keys=True)
-        f.write("\n")
+    atomic_write_json(path, summary)
     print(json.dumps(summary))
     return 0
 

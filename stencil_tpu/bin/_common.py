@@ -179,6 +179,63 @@ def add_stream_overlap_flag(p: argparse.ArgumentParser) -> None:
     )
 
 
+def add_checkpoint_flags(p: argparse.ArgumentParser) -> None:
+    """Long-run survival knobs shared by the model drivers
+    (docs/resilience.md "Long-run operation"): ``--checkpoint-dir`` turns
+    on the checkpoint/resume supervisor for the run (retention ring of
+    atomic checkpoints, SIGTERM-preemption final save + resumable exit,
+    FATAL/STALL restart budget), ``--checkpoint-every`` sets the step
+    cadence, ``--resume`` continues from the newest valid ring entry.
+    Unset knobs fall back to the ``STENCIL_CHECKPOINT_*`` /
+    ``STENCIL_SUPERVISOR_RESTARTS`` environment (validated reads)."""
+    p.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        metavar="DIR",
+        help="checkpoint ring directory; enables the run supervisor "
+        "(reuse an existing ring only together with --resume)",
+    )
+    p.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=None,
+        metavar="N",
+        help="checkpoint every N iterations (default: STENCIL_CHECKPOINT_EVERY)",
+    )
+    p.add_argument(
+        "--checkpoint-keep",
+        type=int,
+        default=None,
+        metavar="K",
+        help="retention-ring size (default: STENCIL_CHECKPOINT_KEEP or 3)",
+    )
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume from the newest valid checkpoint in --checkpoint-dir "
+        "(corrupt entries fall back to older ones)",
+    )
+
+
+def supervisor_for(args, dd, label: str, run_state=None):
+    """A configured ``RunSupervisor`` from ``add_checkpoint_flags``'s
+    choices (environment knobs fill unset flags), or None when no
+    checkpoint dir is configured anywhere — supervision is opt-in."""
+    from stencil_tpu.resilience.supervisor import RunSupervisor, SupervisorConfig
+
+    overrides = {}
+    if getattr(args, "checkpoint_every", None) is not None:
+        overrides["every_steps"] = max(args.checkpoint_every, 0)
+    if getattr(args, "checkpoint_keep", None) is not None:
+        overrides["keep"] = max(args.checkpoint_keep, 1)
+    cfg = SupervisorConfig.from_env(
+        dir=getattr(args, "checkpoint_dir", None), **overrides
+    )
+    if cfg is None:
+        return None
+    return RunSupervisor(dd, cfg, label=label, run_state=run_state)
+
+
 def tune_begin(args) -> None:
     """Apply the ``add_tune_flags`` choices to the tune facade; call right
     after ``parse_args`` (before any model/planner construction).  Pair
@@ -218,13 +275,10 @@ def tune_report_stderr(report) -> None:
 
 
 def _write_snapshot(path: str) -> None:
-    import json
-
     from stencil_tpu import telemetry
+    from stencil_tpu.utils.artifact import atomic_write_json
 
-    with open(path, "w") as f:
-        json.dump(telemetry.snapshot(), f, indent=2, sort_keys=True)
-        f.write("\n")
+    atomic_write_json(path, telemetry.snapshot())
 
 
 def telemetry_begin(args) -> None:

@@ -59,6 +59,7 @@ def main(argv=None) -> int:
     _common.add_tune_flags(p)
     _common.add_stream_overlap_flag(p)
     _common.add_kernel_axis_flags(p)
+    _common.add_checkpoint_flags(p)
     args = p.parse_args(argv)
     _common.telemetry_begin(args)
     _common.tune_begin(args)
@@ -129,25 +130,47 @@ def _run(args) -> int:
         **_common.kernel_axis_kwargs(args),
     )
     sim.realize()
-    sim.step()  # compile
-    sim.block_until_ready()
 
     iter_time = Statistics()
-    for it in range(args.iters):
+
+    def timed_iter():
         t0 = time.perf_counter()
         sim.step()
         sim.block_until_ready()
         iter_time.insert(time.perf_counter() - t0)
-        print(f"iter {it}: {iter_time.max():e}s", file=sys.stderr)
+        print(f"iter {iter_time.count() - 1}: {iter_time.max():e}s", file=sys.stderr)
 
-    if jax.process_index() == 0:
+    sup = _common.supervisor_for(
+        args, sim.dd, label="astaroth",
+        run_state=lambda: {"model": "astaroth", "quantities": args.quantities},
+    )
+    rc = 0
+    if sup is not None:
+        # supervised: no separate warm-up dispatch (bitwise kill/resume
+        # comparability — see bin/jacobi3d.py); first sample absorbs compile
+        def advance(n):
+            for _ in range(n):
+                timed_iter()
+
+        out = sup.run(
+            args.iters, advance,
+            start_step=None if args.resume else 0, chunk=1,
+        )
+        rc = out.exit_code
+    else:
+        sim.step()  # compile
+        sim.block_until_ready()
+        for it in range(args.iters):
+            timed_iter()
+
+    if jax.process_index() == 0 and iter_time.count() > 0:
         ranks, dev_count = _common.ranks_and_devcount()
         print(
             f"astaroth,{_common.method_str(args)},{ranks},{dev_count},"
             f"{x},{y},{z},{iter_time.min()},{iter_time.trimean()}"
         )
     _common.telemetry_end(args)
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

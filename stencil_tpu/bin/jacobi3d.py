@@ -65,6 +65,7 @@ def main(argv=None) -> int:
     _common.add_tune_flags(p)
     _common.add_exchange_route_flag(p)
     _common.add_kernel_axis_flags(p)
+    _common.add_checkpoint_flags(p)
     p.add_argument("x", type=int, nargs="?", default=512)
     p.add_argument("y", type=int, nargs="?", default=512)
     p.add_argument("z", type=int, nargs="?", default=512)
@@ -184,36 +185,80 @@ def _run(args) -> int:
         print(f"wrote {model.dd.write_plan(args.prefix + 'plan')}", file=sys.stderr)
 
     iter_time = Statistics()
-    model.step(args.halo_multiplier)  # compile outside the timed loop
-    model.block_until_ready()
+    sup = _common.supervisor_for(
+        args,
+        model.dd,
+        label="jacobi",
+        run_state=lambda: {
+            "model": "jacobi3d",
+            "kernel_impl": kernel_impl,
+            "compute_unit": model._compute_unit,
+            "iters": args.iters,
+        },
+    )
+    mult = args.halo_multiplier
+
+    def timed_iter():
+        t0 = time.perf_counter()
+        model.step(mult)
+        model.block_until_ready()
+        # one macro (halo_multiplier raw iterations) per timed step; the
+        # CSV stays per-iteration so rows are comparable across multipliers
+        iter_time.insert((time.perf_counter() - t0) / mult)
 
     from stencil_tpu.telemetry import trace
 
-    with trace(args.trace):
-        for it in range(args.iters):
-            t0 = time.perf_counter()
-            model.step(args.halo_multiplier)
-            model.block_until_ready()
-            # one macro (halo_multiplier raw iterations) per timed step; the
-            # CSV stays per-iteration so rows are comparable across multipliers
-            iter_time.insert((time.perf_counter() - t0) / args.halo_multiplier)
+    rc = 0
+    if sup is not None:
+        # supervised long run: no separate warm-up dispatch — a resumed
+        # process must advance EXACTLY (iters - restored) iterations for
+        # kill/resume runs to stay bitwise comparable to unkilled ones
+        # (scripts/run_soak.py); the first timed sample absorbs the compile
+        def advance(n):
+            for _ in range(n):
+                timed_iter()
+
+        def on_chunk(done, n):
+            # same 0-based frame indices as the unsupervised loop (chunk=1:
+            # `it = done - n` is the iteration that just completed)
+            it = done - n
             if args.paraview and it % checkpoint_period == 0:
                 from stencil_tpu.io.paraview import write_paraview
 
                 write_paraview(model.dd, f"{args.prefix}jacobi3d_{it}")
+
+        with trace(args.trace):
+            out = sup.run(
+                args.iters,
+                advance,
+                start_step=None if args.resume else 0,
+                chunk=1,
+                on_chunk=on_chunk,
+            )
+        rc = out.exit_code
+    else:
+        model.step(mult)  # compile outside the timed loop
+        model.block_until_ready()
+        with trace(args.trace):
+            for it in range(args.iters):
+                timed_iter()
+                if args.paraview and it % checkpoint_period == 0:
+                    from stencil_tpu.io.paraview import write_paraview
+
+                    write_paraview(model.dd, f"{args.prefix}jacobi3d_{it}")
     if args.paraview:
         from stencil_tpu.io.paraview import write_paraview
 
         write_paraview(model.dd, f"{args.prefix}jacobi3d_final")
 
-    if jax.process_index() == 0:
+    if jax.process_index() == 0 and iter_time.count() > 0:
         ranks, dev_count = _common.ranks_and_devcount()
         print(
             f"jacobi3d,{_common.method_str(args)},{ranks},{dev_count},"
             f"{x},{y},{z},{iter_time.min()},{iter_time.trimean()}"
         )
     _common.telemetry_end(args)
-    return 0
+    return rc
 
 
 def _global_size(args):

@@ -324,8 +324,9 @@ def emit_overlap(doc, args) -> None:
         return  # multi-host: one writer, or N processes race on the artifact
     print(line)
     if args.json:
-        with open(args.json, "w") as f:
-            f.write(line + "\n")
+        from stencil_tpu.utils.artifact import atomic_write_text
+
+        atomic_write_text(args.json, line + "\n")
 
 
 def build_parser(name: str, overlap_flags: bool = True) -> argparse.ArgumentParser:

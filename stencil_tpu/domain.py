@@ -208,9 +208,60 @@ class DistributedDomain:
         self._divergence_every = env_int("STENCIL_DIVERGENCE_EVERY", 0, minimum=0)
         self._sentinel = None
         self._retry_policy = None
+        # dispatch watchdog (resilience/watchdog.py): resolved lazily from
+        # STENCIL_WATCHDOG_S at first dispatch, or installed programmatically
+        self._watchdog = None
+        self._watchdog_resolved = False
         # analytic bytes per exchange (exchange_bytes_total), computed once
         # per realize() for the telemetry counters
         self._exchange_nbytes: Optional[int] = None
+
+    def set_watchdog(self, wd) -> None:
+        """Install (or clear, with ``None``) a dispatch watchdog
+        (``resilience/watchdog.DispatchWatchdog``): every ``run_step`` and
+        ``exchange`` dispatch is then armed with its deadline — a dispatch
+        that wedges past it emits a ``watchdog.stall`` event naming the
+        phase, and in abort mode is interrupted and re-raised as a
+        classified ``StallError`` for the supervisor to restart on.
+        Without this call, ``STENCIL_WATCHDOG_S`` configures one from the
+        environment at first dispatch."""
+        self._watchdog = wd
+        self._watchdog_resolved = True
+
+    def _get_watchdog(self):
+        if not self._watchdog_resolved:
+            from stencil_tpu.resilience.watchdog import DispatchWatchdog
+
+            self._watchdog = DispatchWatchdog.from_env()
+            self._watchdog_resolved = True
+        return self._watchdog
+
+    def _watched_call(self, phase: str, fn):
+        """Run one dispatch under the watchdog (when configured).
+
+        The jitted call returns at ENQUEUE on asynchronous backends — a
+        wedged collective surfaces at the sync — so the watched region
+        includes a ``block_until_ready`` on the dispatch's own outputs:
+        the deadline covers the execution, not just the enqueue.  (The
+        sync is watchdog-mode only; unwatched dispatches keep jax's async
+        pipelining.)  An abort-mode interrupt is converted into the
+        classified ``StallError``; in observe-only mode a KeyboardInterrupt
+        stays a KeyboardInterrupt — a user Ctrl-C must never be re-labeled
+        by a stale, uninterrupting deadline trip."""
+        wd = self._get_watchdog()
+        if wd is None:
+            return fn()
+        try:
+            with wd.watch(phase):
+                out = fn()
+                jax.block_until_ready(out)
+                return out
+        except KeyboardInterrupt:
+            if wd.abort:
+                stall = wd.take_stall()
+                if stall is not None:
+                    raise stall from None
+            raise
 
     def set_divergence_check(self, every: int) -> None:
         """Enable the divergence sentinel (resilience/sentinel.py): every
@@ -912,7 +963,9 @@ class DistributedDomain:
         with self._phase_timer(
             "time_exchange", tm.EXCHANGE_SECONDS, tm.SPAN_EXCHANGE, sync=True
         ):
-            self._curr = self._exchange_fn(self._curr)
+            self._curr = self._watched_call(
+                "exchange", lambda: self._exchange_fn(self._curr)
+            )
             self._shell_stale = False
         self._exchange_count += 1
         self._account_exchanges(1)
@@ -1022,8 +1075,9 @@ class DistributedDomain:
         )
         lines += format_cost_report(rows, total_ms, link, self._halo_mult)
         path = f"{prefix}_{jax.process_index()}.txt"
-        with open(path, "w") as f:
-            f.write("\n".join(lines) + "\n")
+        from stencil_tpu.utils.artifact import atomic_write_text
+
+        atomic_write_text(path, "\n".join(lines) + "\n")
         return path
 
     def exchange_bytes_for_method(self, method: MethodFlags) -> int:
@@ -1302,6 +1356,10 @@ class DistributedDomain:
           donation propagates instead of re-reading freed memory;
         * the ``STENCIL_FAULT_PLAN`` hook fires here with phase
           ``dispatch`` and this call's ``label`` (models pass their name);
+        * the dispatch watchdog (``STENCIL_WATCHDOG_S`` /
+          ``set_watchdog``) is armed around the dispatch: a wedge past the
+          deadline emits a ``watchdog.stall`` event, and in abort mode
+          surfaces as a classified ``StallError`` for supervisor recovery;
         * the divergence sentinel (``set_divergence_check``) runs on its
           cadence after a successful dispatch.
 
@@ -1323,7 +1381,9 @@ class DistributedDomain:
 
         def dispatch():
             inject.maybe_fail("dispatch", label)
-            return step_fn(self._curr, steps)
+            return self._watched_call(
+                f"dispatch:{label}", lambda: step_fn(self._curr, steps)
+            )
 
         raw = steps * getattr(step_fn, "_raw_steps_per_call", 1)
         timed = telemetry.enabled()

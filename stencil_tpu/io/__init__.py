@@ -1,7 +1,25 @@
 """Persistence: paraview point dumps (reference parity) and checkpoint/resume
-(a deliberate improvement over the reference, which has none — SURVEY.md §5)."""
+(a deliberate improvement over the reference, which has none — SURVEY.md §5;
+hardened for preemption-tolerant long runs: atomic commit, digest-verified
+manifests, retention ring, elastic cross-mesh restore — docs/resilience.md
+"Long-run operation")."""
 
+from stencil_tpu.io.checkpoint import (
+    latest_valid,
+    load_manifest,
+    restore_checkpoint,
+    save_checkpoint,
+    save_to_ring,
+    validate_checkpoint,
+)
 from stencil_tpu.io.paraview import write_paraview
-from stencil_tpu.io.checkpoint import save_checkpoint, restore_checkpoint
 
-__all__ = ["write_paraview", "save_checkpoint", "restore_checkpoint"]
+__all__ = [
+    "write_paraview",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "save_to_ring",
+    "latest_valid",
+    "load_manifest",
+    "validate_checkpoint",
+]

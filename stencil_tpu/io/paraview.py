@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from stencil_tpu.core.dim3 import Dim3
+from stencil_tpu.utils.artifact import atomic_write
 
 
 def write_paraview(dd, prefix: str, zero_nans: bool = True) -> None:
@@ -63,4 +64,8 @@ def write_paraview(dd, prefix: str, zero_nans: bool = True) -> None:
         table = np.column_stack(cols)
         header = "Z,Y,X" + "".join(f",{c}" for c in names)
         fmt = ["%d", "%d", "%d"] + ["%f"] * len(names)
-        np.savetxt(path, table, fmt=fmt, delimiter=",", header=header, comments="")
+        # atomic per-file: a dump interrupted by preemption must not leave a
+        # truncated CSV next to complete ones (the artifact-write contract —
+        # np.savetxt's own open() would)
+        with atomic_write(path) as f:
+            np.savetxt(f, table, fmt=fmt, delimiter=",", header=header, comments="")

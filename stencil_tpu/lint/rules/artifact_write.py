@@ -1,0 +1,104 @@
+"""Rule ``artifact-write``: durable run artifacts are written ATOMICALLY.
+
+Checkpoints, tuned-config cache entries, bench/metrics JSON, weak-scaling
+sweeps, plan dumps — a run artifact written with a bare ``open(path, "w")``
+is a truncated half-file the moment the process is preempted mid-write,
+and the long-run survival layer (docs/resilience.md "Long-run operation")
+exists precisely because processes die mid-anything.  Every such write
+goes through ``stencil_tpu/utils/artifact.py`` (``atomic_write`` /
+``atomic_write_json`` / ``atomic_write_text``: same-directory temp file,
+fsync, ``os.replace``), so the destination either keeps its old content or
+atomically becomes the new content.
+
+The rule flags ``open``/``io.open``/``os.fdopen`` calls whose mode creates
+or truncates (``w``/``x`` modes).  Out of scope by design:
+
+* append-mode streams (``"a"``) — the JSONL event sink's per-line append
+  IS its crash contract (every line a complete document);
+* reads and read-modify (``"r"``, ``"r+"``);
+* ``tests/`` (tmp-path scratch is not an artifact) and the helper module
+  itself (it is the sanctioned ``open`` site).
+
+A non-artifact write (a fixture generator, a deliberately streaming file)
+suppresses with a reason, as always.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from stencil_tpu.lint.framework import FileContext, Rule, Violation, register
+
+#: the one module whose open() IS the atomic implementation
+HELPER_MODULE = "stencil_tpu/utils/artifact.py"
+
+_OPEN_NAMES = {"open"}
+_OPEN_ATTRS = {("io", "open"), ("os", "fdopen")}
+
+
+def _open_mode(node: ast.Call) -> Optional[str]:
+    """The literal mode of an open-like call, or None (defaults to 'r',
+    which never truncates)."""
+    f = node.func
+    is_open = (isinstance(f, ast.Name) and f.id in _OPEN_NAMES) or (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and (f.value.id, f.attr) in _OPEN_ATTRS
+    )
+    if not is_open:
+        return None
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) and isinstance(
+            kw.value.value, str
+        ):
+            return kw.value.value
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) and isinstance(
+        node.args[1].value, str
+    ):
+        return node.args[1].value
+    return "r" if (node.args or node.keywords) else None
+
+
+@register
+class ArtifactWriteRule(Rule):
+    name = "artifact-write"
+    why = (
+        "bare open(path, 'w') leaves a truncated artifact when the process "
+        "dies mid-write; route run artifacts through utils/artifact.py's "
+        "atomic_write helpers (temp + fsync + rename)"
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        if rel == HELPER_MODULE:
+            return False
+        return (
+            rel.startswith("stencil_tpu/")
+            or rel.startswith("scripts/")
+            or rel == "bench.py"
+        )
+
+    def check(self, ctx: FileContext) -> List[Violation]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            mode = _open_mode(node)
+            if mode is None or not any(c in mode for c in "wx"):
+                # 'r'/'r+' never truncate; 'a' streams are the JSONL
+                # contract (module docstring); only create/truncate modes
+                # can shear an artifact
+                continue
+            out.append(
+                ctx.violation(
+                    self.name,
+                    node,
+                    f"bare open(..., {mode!r}) write — a kill mid-write "
+                    "leaves a truncated artifact; use atomic_write/"
+                    "atomic_write_json from stencil_tpu/utils/artifact.py "
+                    "(or suppress with the reason this file is not a run "
+                    "artifact)",
+                )
+            )
+        return out

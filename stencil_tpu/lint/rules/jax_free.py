@@ -27,6 +27,9 @@ JAX_FREE_FILES = {
     "stencil_tpu/resilience/taxonomy.py",
     "stencil_tpu/resilience/inject.py",
     "stencil_tpu/utils/config.py",
+    # imported by the jax-free telemetry package (trace dumps) and on
+    # exception-handler exit paths — must stay stdlib-only
+    "stencil_tpu/utils/artifact.py",
 }
 
 

@@ -2,12 +2,15 @@
 
 ``STENCIL_FAULT_PLAN`` holds a comma-separated list of fault entries:
 
-    entry := phase ':' class [':' label-glob] ['*' count]
+    entry := phase ':' class [':' label-glob] ['@' skip] ['*' count]
     phase := compile | execute | dispatch | any
     class := vmem_oom | compile_reject | transient | divergence | fatal
+           | sigkill | sigterm
 
-Each entry fires ``count`` times (default 1) at matching hook sites, then is
-spent.  Phases map to the three hook sites:
+Each entry first lets ``skip`` matching hook calls pass untouched (default
+0 — the chaos harness's "die at the K-th dispatch" primitive), then fires
+``count`` times (default 1), then is spent.  Phases map to the three hook
+sites:
 
 * ``compile``  — inside ``DegradationLadder`` when a rung's step impl is
   (re)built: models a compiler rejection before any execution.
@@ -33,11 +36,20 @@ name: ``jacobi``, ``astaroth``).  Examples:
     STENCIL_FAULT_PLAN='dispatch:transient:astaroth*9'
         -> every astaroth dispatch fails with a tunnel-style transient error
            until the 9 charges are spent (outlasting the retry budget)
+    STENCIL_FAULT_PLAN='dispatch:sigkill:jacobi@7'
+        -> the 8th jacobi dispatch kills the PROCESS with SIGKILL — the
+           chaos/soak harness's preemption-without-warning primitive
+           (scripts/run_soak.py); 'sigterm' delivers the polite variant the
+           supervisor's handler turns into a final checkpoint + resumable
+           exit
 
 Injected VMEM_OOM / COMPILE_REJECT / TRANSIENT faults are raised as
 ``InjectedFault`` with the SAME message wording the real toolchain emits, so
 they flow through ``classify()``'s substring matching exactly like the real
-thing; DIVERGENCE raises a typed ``DivergenceError``.
+thing; DIVERGENCE raises a typed ``DivergenceError``.  The process-level
+kill classes do not raise at all: they deliver a real signal to this
+process (``os.kill``), exercising the supervisor exactly like a cloud
+preemption would.
 
 The plan is parsed lazily from the environment on first use and re-parsed
 whenever the env var's value changes (so tests can monkeypatch it without an
@@ -69,6 +81,10 @@ _CLASSES = {
     "divergence": FailureClass.DIVERGENCE,
     "fatal": FailureClass.FATAL,
 }
+#: process-level kill classes: a REAL signal to this process, not an
+#: exception — sigkill models preemption-without-warning (no cleanup runs),
+#: sigterm the polite notice the supervisor checkpoints on
+_KILLS = ("sigkill", "sigterm")
 
 #: The message each injected class carries — the REAL toolchain wording (the
 #: same texts ``taxonomy`` pins), tagged with the injection site.
@@ -90,14 +106,17 @@ _MESSAGES = {
 @dataclasses.dataclass
 class _Entry:
     phase: str
-    cls: FailureClass
+    cls: Optional[FailureClass]  # None for the process-kill classes
+    kill: Optional[str]  # "sigkill" | "sigterm" | None
     label_glob: str
+    skip: int
     remaining: int
 
 
 def _parse_entry(text: str) -> _Entry:
     text = text.strip()
     count = 1
+    skip = 0
     # the count suffix is ONLY a trailing '*<digits>' — a '*' elsewhere is
     # part of the label glob (e.g. 'execute:vmem_oom:*wavefront*3')
     m = re.match(r"^(.*)\*(\d+)$", text)
@@ -105,6 +124,11 @@ def _parse_entry(text: str) -> _Entry:
         text, count = m.group(1), int(m.group(2))
         if count < 1:
             raise ValueError(f"{ENV_VAR}: count must be >= 1, got {count}")
+    # ...and the skip suffix a trailing '@<digits>' before it ('die at the
+    # K-th dispatch' = '@K-1', or '@K' counting the fired one as K+1st)
+    m = re.match(r"^(.*)@(\d+)$", text)
+    if m:
+        text, skip = m.group(1), int(m.group(2))
     # split at most twice: ladder labels themselves contain colons
     # ("stream:wavefront[m=3]"), so everything after the class is the glob
     parts = text.split(":", 2)
@@ -115,7 +139,7 @@ def _parse_entry(text: str) -> _Entry:
         phase, cls_name, label_glob = parts
     else:
         raise ValueError(
-            f"{ENV_VAR}: entry {text!r} is not phase:class[:label][*count]"
+            f"{ENV_VAR}: entry {text!r} is not phase:class[:label][@skip][*count]"
         )
     phase = phase.strip().lower()
     cls_name = cls_name.strip().lower()
@@ -123,12 +147,19 @@ def _parse_entry(text: str) -> _Entry:
         raise ValueError(
             f"{ENV_VAR}: unknown phase {phase!r} (one of {', '.join(_PHASES)})"
         )
-    if cls_name not in _CLASSES:
+    if cls_name not in _CLASSES and cls_name not in _KILLS:
         raise ValueError(
             f"{ENV_VAR}: unknown failure class {cls_name!r} "
-            f"(one of {', '.join(_CLASSES)})"
+            f"(one of {', '.join(_CLASSES)}, {', '.join(_KILLS)})"
         )
-    return _Entry(phase, _CLASSES[cls_name], label_glob.strip() or "*", count)
+    return _Entry(
+        phase,
+        _CLASSES.get(cls_name),
+        cls_name if cls_name in _KILLS else None,
+        label_glob.strip() or "*",
+        skip,
+        count,
+    )
 
 
 class FaultPlan:
@@ -162,8 +193,35 @@ class FaultPlan:
                 or fnmatch.fnmatchcase(label, e.label_glob + "*")
             ):
                 continue
+            if e.skip > 0:
+                # an un-fired pass-through: this entry lets the match
+                # through but stays armed (independent entries may still
+                # fire below)
+                e.skip -= 1
+                continue
             e.remaining -= 1
+            if e.kill is not None:
+                _kill(e.kill, phase, label)
+                return  # sigterm: the handler ran; the dispatch proceeds
             _raise(e.cls, phase, label)
+
+
+def _kill(kind: str, phase: str, label: str) -> None:
+    """Deliver a REAL signal to this process.  SIGKILL never returns (the
+    kernel reaps us mid-bytecode — exactly a preemption without notice);
+    SIGTERM runs the installed handler synchronously at the next bytecode
+    boundary and returns, letting the supervisor observe its flag at the
+    step boundary."""
+    import signal as _signal
+
+    from stencil_tpu import telemetry
+    from stencil_tpu.telemetry import names as tm
+
+    telemetry.inc(tm.FAULTS_INJECTED)
+    telemetry.emit_event(
+        tm.EVENT_FAULT, phase=phase, label=label, failure_class=kind
+    )
+    os.kill(os.getpid(), _signal.SIGKILL if kind == "sigkill" else _signal.SIGTERM)
 
 
 def _raise(cls: FailureClass, phase: str, label: str) -> None:

@@ -1,0 +1,350 @@
+"""Checkpoint/resume run supervisor: the rung BELOW the in-process ladder.
+
+The resilience story so far is in-process: VMEM_OOM / COMPILE_REJECT walk
+the degradation ladder, TRANSIENT retries with backoff, DIVERGENCE
+propagates.  What none of that survives is the process dying — a
+preemption notice, a SIGKILL, a FATAL dispatch error, a wedged device.
+``RunSupervisor`` closes that gap around any step loop:
+
+* **Cadence checkpoints** — every N steps and/or every T wall-clock
+  seconds, an atomic checkpoint lands in the retention ring
+  (``io/checkpoint.save_to_ring``), carrying the step counter and the
+  caller's resumable run state (tuned decisions in effect, kernel axes).
+* **Preemption handling** — a SIGTERM (the cloud preemption notice) or
+  ``KeyboardInterrupt`` is classified PREEMPTED, takes one final
+  checkpoint (donation-guarded: a mid-dispatch kill whose buffers are
+  already consumed skips the save — the last ring entry stands), and
+  returns a resumable outcome (``EXIT_RESUMABLE``, the sysexits
+  EX_TEMPFAIL convention schedulers re-queue on).
+* **Resume** — ``resume()`` restores the newest VALID ring checkpoint
+  (corrupt entries fall back to older ones) and returns the step to
+  continue from; the saved ``run_state`` is exposed for the caller to
+  re-apply its decisions.
+* **Restart budget** — a FATAL or STALL classification mid-run restores
+  the last valid checkpoint IN-PROCESS and re-runs, up to
+  ``max_restarts`` times (``supervisor.restart`` event + counter per
+  restart).  The ladder keeps handling VMEM_OOM/COMPILE_REJECT and retry
+  keeps handling TRANSIENT before anything reaches here; DIVERGENCE is
+  never restarted (the same numerics diverge again).
+
+Knobs (validated reads — utils/config.py): ``STENCIL_CHECKPOINT_DIR``,
+``STENCIL_CHECKPOINT_EVERY`` (steps), ``STENCIL_CHECKPOINT_EVERY_S``
+(wall-clock), ``STENCIL_CHECKPOINT_KEEP`` (ring size),
+``STENCIL_CHECKPOINT_BACKEND`` (auto|npz|orbax),
+``STENCIL_CHECKPOINT_VERIFY`` (digest checks on restore),
+``STENCIL_SUPERVISOR_RESTARTS`` (restart budget).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+from stencil_tpu import telemetry
+from stencil_tpu.io.checkpoint import restore_latest, save_to_ring
+from stencil_tpu.resilience.retry import buffers_live
+from stencil_tpu.resilience.taxonomy import FailureClass, classify
+from stencil_tpu.telemetry import names as tm
+from stencil_tpu.utils.logging import log_info, log_warn
+
+#: sysexits EX_TEMPFAIL — "try again later"; schedulers re-queue this code
+EXIT_RESUMABLE = 75
+
+#: sentinel for "no SIGTERM handler was installed" (distinct from a
+#: previous handler that reads back as None — installed at the C level)
+_NOT_INSTALLED = object()
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    """Where and how often to checkpoint, and how hard to fight for the run."""
+
+    dir: str
+    every_steps: int = 0  # 0 = no step cadence
+    every_seconds: float = 0.0  # 0 = no wall-clock cadence
+    keep: int = 3
+    max_restarts: int = 2
+    backend: Optional[str] = None  # None = orbax when installed, else npz
+    verify: bool = True
+
+    @classmethod
+    def from_env(cls, dir: Optional[str] = None, **overrides) -> Optional["SupervisorConfig"]:
+        """Environment-driven config; returns None when no directory is set
+        anywhere (supervision is strictly opt-in)."""
+        from stencil_tpu.utils.config import (
+            env_bool,
+            env_choice,
+            env_float,
+            env_int,
+            env_str,
+        )
+
+        dir = dir or env_str("STENCIL_CHECKPOINT_DIR", None)
+        if dir is None:
+            return None
+        backend = env_choice(
+            "STENCIL_CHECKPOINT_BACKEND", "auto", ("auto", "npz", "orbax")
+        )
+        fields = dict(
+            dir=dir,
+            every_steps=env_int("STENCIL_CHECKPOINT_EVERY", 0, minimum=0),
+            every_seconds=env_float("STENCIL_CHECKPOINT_EVERY_S", 0.0, minimum=0.0),
+            keep=env_int("STENCIL_CHECKPOINT_KEEP", 3, minimum=1),
+            max_restarts=env_int("STENCIL_SUPERVISOR_RESTARTS", 2, minimum=0),
+            backend=None if backend == "auto" else backend,
+            verify=env_bool("STENCIL_CHECKPOINT_VERIFY", True),
+        )
+        fields.update(overrides)
+        return cls(**fields)
+
+
+@dataclasses.dataclass
+class RunOutcome:
+    """What ``run`` achieved: ``completed`` runs reached ``total_steps``;
+    preempted runs stopped early with a final checkpoint and the resumable
+    exit code."""
+
+    completed: bool
+    step: int
+    restarts: int
+    preempted: bool = False
+    exit_code: int = 0
+
+
+class RunSupervisor:
+    """Wraps a step loop with checkpoint/resume/restart (module docstring).
+
+    ``run_state`` is a zero-arg callable returning the JSON-safe decision
+    record to persist with every checkpoint (tuned picks, kernel axes);
+    after ``resume()`` the restored record is available as
+    ``last_run_state`` for the caller to re-apply.
+    """
+
+    def __init__(
+        self,
+        dd,
+        config: SupervisorConfig,
+        label: str = "run",
+        run_state: Optional[Callable[[], dict]] = None,
+    ):
+        self.dd = dd
+        self.config = config
+        self.label = label
+        self._run_state = run_state
+        self.last_run_state: dict = {}
+        #: the ring path the last resume() restored from (None = cold start)
+        self.resumed_path: Optional[str] = None
+        self._preempted = False
+        self._preempt_why = ""
+
+    # --- resume ---------------------------------------------------------------
+
+    def resume(self) -> int:
+        """Restore the newest ring checkpoint that restores CLEANLY into
+        the domain; returns the step to continue from (0 on a cold start —
+        distinguish via ``resumed_path``).  Entries that fail structurally
+        OR at restore-time digest verification are skipped (counted +
+        event-logged by ``restore_latest``), each hashed exactly once."""
+        self.resumed_path = None
+        found = restore_latest(self.dd, self.config.dir, verify=self.config.verify)
+        if found is None:
+            log_info(f"{self.label}: no checkpoint under {self.config.dir}; cold start")
+            return 0
+        path, manifest, step = found
+        self.last_run_state = manifest.get("run_state") or {}
+        self.resumed_path = path
+        return step
+
+    # --- checkpointing --------------------------------------------------------
+
+    def checkpoint(self, step: int, reason: str = "cadence") -> str:
+        return save_to_ring(
+            self.dd,
+            self.config.dir,
+            step,
+            keep=self.config.keep,
+            backend=self.config.backend,
+            run_state=self._run_state() if self._run_state is not None else None,
+            reason=reason,
+        )
+
+    def _final_checkpoint(self, step: int, reason: str) -> None:
+        """Best-effort final save: skipped (with the last ring entry left
+        standing) when the interrupted dispatch already consumed its donated
+        buffers — reading them back would be a use-after-free."""
+        if not buffers_live(self.dd._curr):
+            log_warn(
+                f"{self.label}: skipping final checkpoint at step {step} — a "
+                "donated buffer was already consumed mid-dispatch; the last "
+                "ring checkpoint stands"
+            )
+            return
+        try:
+            self.checkpoint(step, reason=reason)
+        except Exception as e:  # the exit path must stay resumable
+            log_warn(f"{self.label}: final checkpoint failed ({e}); the last ring checkpoint stands")
+
+    # --- preemption -----------------------------------------------------------
+
+    def _install_sigterm(self):
+        """SIGTERM -> preemption flag, checked between chunks.  Only the
+        main thread may install handlers; elsewhere (a driver already under
+        its own supervisor thread) SIGTERM keeps its default meaning.
+        Returns ``_NOT_INSTALLED`` when nothing was installed — distinct
+        from a previous handler of ``None`` (set at the C level), which
+        must still be restored (as SIG_DFL) on exit."""
+        if threading.current_thread() is not threading.main_thread():
+            return _NOT_INSTALLED
+
+        def handler(signum, frame):
+            self._preempted = True
+            self._preempt_why = "SIGTERM"
+            log_warn(
+                f"{self.label}: SIGTERM — will checkpoint and exit resumable "
+                "at the next step boundary"
+            )
+
+        try:
+            return signal.signal(signal.SIGTERM, handler)
+        except (ValueError, OSError):  # non-main interpreter contexts
+            return _NOT_INSTALLED
+
+    # --- the supervised loop --------------------------------------------------
+
+    def run(
+        self,
+        total_steps: int,
+        advance: Callable[[int], None],
+        start_step: Optional[int] = None,
+        chunk: Optional[int] = None,
+        on_chunk: Optional[Callable[[int, int], None]] = None,
+    ) -> RunOutcome:
+        """Drive ``advance(n)`` from ``start_step`` (default: ``resume()``)
+        to ``total_steps`` under the full survival contract.  ``chunk``
+        bounds the steps per ``advance`` call (default: the step cadence, or
+        the whole remainder); ``on_chunk(done_step, n)`` runs after each
+        successful chunk (drivers hang their timing/paraview hooks here)."""
+        cfg = self.config
+        step = self.resume() if start_step is None else int(start_step)
+        if chunk is None:
+            if cfg.every_steps:
+                chunk = cfg.every_steps
+            elif cfg.every_seconds:
+                # wall-clock-only cadence: the timer is only consulted
+                # BETWEEN chunks, so one whole-remainder chunk would never
+                # checkpoint mid-run — step singly instead
+                chunk = 1
+            else:
+                chunk = max(total_steps - step, 1)
+        chunk = max(int(chunk), 1)
+        restarts = 0
+        self._preempted = False
+        prev_handler = self._install_sigterm()
+        last_ck = time.monotonic()
+        from stencil_tpu.io.checkpoint import ring_entries
+
+        if not ring_entries(cfg.dir):
+            # anchor the ring: a FATAL/STALL before the first cadence
+            # checkpoint must still have a rung to restart from (a cheap
+            # listdir — the resume() above already paid the validation
+            # pass when entries existed)
+            self.checkpoint(step, reason="initial")
+        try:
+            while step < total_steps:
+                n = min(chunk, total_steps - step)
+                if cfg.every_steps:
+                    # land chunks ON cadence boundaries so resumed runs
+                    # re-walk identical dispatch partitions
+                    to_boundary = cfg.every_steps - (step % cfg.every_steps)
+                    n = min(n, to_boundary)
+                mid_chunk = False
+                try:
+                    advance(n)
+                except (Exception, KeyboardInterrupt) as e:
+                    cls = classify(e)
+                    if cls is FailureClass.PREEMPTED:
+                        # the chunk died partway: the domain is an UNKNOWN
+                        # number of iterations past `step`, so no final
+                        # checkpoint may be labeled with it — the last ring
+                        # entry stands and resume re-runs from there
+                        # (deterministic, so still bitwise)
+                        self._preempted = True
+                        mid_chunk = True
+                        self._preempt_why = self._preempt_why or type(e).__name__
+                    elif (
+                        cls in (FailureClass.FATAL, FailureClass.STALL)
+                        and restarts < cfg.max_restarts
+                    ):
+                        restored = self.resume()
+                        if self.resumed_path is None:
+                            raise  # nothing valid to restart from
+                        restarts += 1
+                        telemetry.inc(tm.SUPERVISOR_RESTARTS)
+                        telemetry.emit_event(
+                            tm.EVENT_SUPERVISOR_RESTART,
+                            label=self.label,
+                            step=step,
+                            restart=restarts,
+                            budget=cfg.max_restarts,
+                            failure_class=cls.value,
+                            error=str(e)[:300],
+                        )
+                        log_warn(
+                            f"{self.label}: {cls.value} at step ~{step} "
+                            f"({e}); restarting from the last checkpoint "
+                            f"({restarts}/{cfg.max_restarts})"
+                        )
+                        step = restored
+                        last_ck = time.monotonic()
+                        continue
+                    else:
+                        # out of budget, no checkpoint to restart from, or a
+                        # class the in-process machinery owns — propagate
+                        raise
+                else:
+                    step += n
+                    if on_chunk is not None:
+                        on_chunk(step, n)
+                if self._preempted:
+                    if mid_chunk:
+                        log_warn(
+                            f"{self.label}: preemption interrupted a chunk "
+                            f"mid-flight; skipping the final checkpoint (step "
+                            "label would be stale) — the last ring entry stands"
+                        )
+                    else:
+                        self._final_checkpoint(step, reason="preempt")
+                    log_warn(
+                        f"{self.label}: preempted ({self._preempt_why}) at "
+                        f"step {step}; exiting resumable (code {EXIT_RESUMABLE})"
+                    )
+                    return RunOutcome(
+                        completed=False,
+                        step=step,
+                        restarts=restarts,
+                        preempted=True,
+                        exit_code=EXIT_RESUMABLE,
+                    )
+                now = time.monotonic()
+                hit_steps = cfg.every_steps and step % cfg.every_steps == 0
+                hit_wall = cfg.every_seconds and now - last_ck >= cfg.every_seconds
+                if step < total_steps and (hit_steps or hit_wall):
+                    self.checkpoint(step, reason="cadence")
+                    last_ck = now
+        finally:
+            if prev_handler is not _NOT_INSTALLED:
+                # a C-level previous handler reads back as None — restore
+                # the default disposition rather than leaving OUR handler
+                # swallowing SIGTERMs after run() returned
+                signal.signal(
+                    signal.SIGTERM,
+                    prev_handler if prev_handler is not None else signal.SIG_DFL,
+                )
+        # completion checkpoint: the artifact soak/chaos harnesses compare
+        # (manifest digests make that a metadata read), and the natural
+        # resume-past-the-end no-op marker
+        self.checkpoint(step, reason="final")
+        return RunOutcome(completed=True, step=step, restarts=restarts)

@@ -1,6 +1,6 @@
 """Failure taxonomy: one ``classify(exc)`` for every error-handling site.
 
-Five classes cover everything the framework reacts to differently:
+Seven classes cover everything the framework reacts to differently:
 
 * ``VMEM_OOM``          — Mosaic rejected a kernel because its scoped-VMEM
   request does not fit (the calibrated model under-estimated on this
@@ -17,6 +17,17 @@ Five classes cover everything the framework reacts to differently:
 * ``DIVERGENCE``        — the simulation itself went non-finite
   (``sentinel.py``).  Never retried: re-running the same numerics diverges
   again; the caller must change the model or step size.
+* ``PREEMPTED``         — the RUN was told to stop: ``KeyboardInterrupt``,
+  or the supervisor's SIGTERM/preemption notice (``PreemptionError``).
+  Never retried and never degraded — a preemption deadline is burning; the
+  supervisor (``supervisor.py``) takes a final checkpoint and exits with a
+  resumable status.  Distinct from TRANSIENT_RUNTIME so the retry loop can
+  never swallow a preemption notice by re-running the work.
+* ``STALL``             — a dispatch exceeded the watchdog deadline
+  (``watchdog.py``): the device or its tunnel is wedged, not failing fast.
+  Handled like FATAL by in-process machinery (no retry — the same dispatch
+  would wedge again); the supervisor's restart-from-checkpoint budget is
+  the recovery rung.
 * ``FATAL``             — everything else.  Propagates unchanged.
 
 Classification is by exception type first (``ResilienceError`` subclasses
@@ -36,6 +47,8 @@ class FailureClass(enum.Enum):
     COMPILE_REJECT = "compile_reject"
     TRANSIENT_RUNTIME = "transient"
     DIVERGENCE = "divergence"
+    PREEMPTED = "preempted"
+    STALL = "stall"
     FATAL = "fatal"
 
 
@@ -57,6 +70,48 @@ class DivergenceError(ResilienceError):
             f"quantity {quantity!r} contains non-finite values at step {step} "
             "(divergence sentinel)"
         )
+
+
+class PreemptionError(ResilienceError):
+    """The run was asked to terminate (SIGTERM / preemption notice /
+    watchdog-abort conversion site).  Raised by the supervisor's signal
+    handler path, never by infrastructure — so it can never be confused
+    with a retryable TRANSIENT_RUNTIME flake."""
+
+    failure_class = FailureClass.PREEMPTED
+
+    def __init__(self, why: str = "SIGTERM"):
+        self.why = why
+        super().__init__(f"run preempted ({why}); checkpoint and exit resumable")
+
+
+class StallError(ResilienceError):
+    """A dispatch exceeded the watchdog deadline (``watchdog.py``).  Carries
+    the last-known phase so the supervisor's restart event can say WHERE the
+    run wedged."""
+
+    failure_class = FailureClass.STALL
+
+    def __init__(self, phase: str, deadline_s: float):
+        self.phase = phase
+        self.deadline_s = deadline_s
+        super().__init__(
+            f"dispatch stalled: {phase!r} exceeded the {deadline_s:g}s "
+            "watchdog deadline (STENCIL_WATCHDOG_S)"
+        )
+
+
+class CheckpointCorruptError(ResilienceError):
+    """A checkpoint failed validation (missing/partial manifest, digest
+    mismatch, unreadable state).  FATAL by class — there is nothing to retry
+    or degrade; ``io/checkpoint.latest_valid`` responds by falling back to
+    the previous checkpoint in the retention ring, and only raises this when
+    no valid checkpoint remains."""
+
+    def __init__(self, path: str, why: str):
+        self.path = path
+        self.why = why
+        super().__init__(f"checkpoint {path} is not usable: {why}")
 
 
 class InjectedFault(RuntimeError):
@@ -133,6 +188,15 @@ def classify(exc: BaseException) -> FailureClass:
     """
     if isinstance(exc, ResilienceError):
         return exc.failure_class
+    if isinstance(exc, KeyboardInterrupt):
+        # typed check BEFORE any substring matching: Ctrl-C / SIGINT-driven
+        # termination is a preemption notice, and no marker list may ever
+        # reclassify it to a retryable class (tests pin this).  The retry
+        # and ladder loops additionally catch only ``Exception``, so a
+        # KeyboardInterrupt propagates even uninspected — this makes the
+        # contract explicit for call sites that do classify BaseExceptions
+        # (the supervisor).
+        return FailureClass.PREEMPTED
     explicit = getattr(exc, "failure_class", None)
     if isinstance(explicit, FailureClass):
         return explicit

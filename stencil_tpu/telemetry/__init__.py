@@ -219,7 +219,9 @@ def dump_chrome_trace(path: Optional[str] = None) -> Optional[str]:
         if t.out_dir is None:
             return None
         path = os.path.join(t.out_dir, f"trace_{_rank()}.json")
-    with open(path, "w") as f:
+    from stencil_tpu.utils.artifact import atomic_write
+
+    with atomic_write(path) as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return path
 

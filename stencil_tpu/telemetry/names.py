@@ -68,6 +68,22 @@ STEP_OVERLAP_EXTERIOR_CELLS = "step.overlap.exterior_cells"
 #: dense band-matmul FLOPs per level per plane, modeled once per build like
 #: the exchange bytes; 0 under ``compute_unit=vpu``
 KERNEL_MXU_FLOPS = "kernel.mxu.flops"
+#: checkpoints committed (atomic rename completed — io/checkpoint.py)
+CHECKPOINT_SAVES = "checkpoint.saves"
+#: bytes of quantity data written by those checkpoints (interior cells at
+#: the NATIVE dtype — the portable representation the digests cover)
+CHECKPOINT_SAVE_BYTES = "checkpoint.save.bytes"
+#: successful checkpoint restores (elastic cross-mesh restores included)
+CHECKPOINT_RESTORES = "checkpoint.restores"
+#: checkpoints REJECTED by validation (missing/partial manifest, digest
+#: mismatch) — each one the retention-ring fallback skipped past
+CHECKPOINT_INVALID = "checkpoint.invalid"
+#: supervisor restarts from the last valid checkpoint after a FATAL/STALL
+#: dispatch classification (resilience/supervisor.py restart budget)
+SUPERVISOR_RESTARTS = "supervisor.restarts"
+#: watchdog deadline trips (resilience/watchdog.py): dispatches that ran
+#: past STENCIL_WATCHDOG_S without completing
+WATCHDOG_STALLS = "watchdog.stalls"
 
 ALL_COUNTERS = frozenset({
     EXCHANGE_COUNT,
@@ -89,14 +105,23 @@ ALL_COUNTERS = frozenset({
     TUNE_SELECTED,
     STEP_OVERLAP_EXTERIOR_CELLS,
     KERNEL_MXU_FLOPS,
+    CHECKPOINT_SAVES,
+    CHECKPOINT_SAVE_BYTES,
+    CHECKPOINT_RESTORES,
+    CHECKPOINT_INVALID,
+    SUPERVISOR_RESTARTS,
+    WATCHDOG_STALLS,
 })
 
 # --- gauges (last-value) -----------------------------------------------------
 
 #: analytic bytes per single exchange across all subdomains
 EXCHANGE_BYTES_PER_EXCHANGE = "domain.exchange.bytes_per_exchange"
+#: checkpoints currently RETAINED in the ring after pruning (last value of
+#: ``keep``-bounded ring size — io/checkpoint.py ``save_to_ring``)
+CHECKPOINT_RETAINED = "checkpoint.retained"
 
-ALL_GAUGES = frozenset({EXCHANGE_BYTES_PER_EXCHANGE})
+ALL_GAUGES = frozenset({EXCHANGE_BYTES_PER_EXCHANGE, CHECKPOINT_RETAINED})
 
 # --- histograms (Statistics-backed: min/max/avg/stddev/med/trimean) ----------
 
@@ -112,6 +137,10 @@ SWAP_SECONDS = "domain.swap.seconds"
 COMPILE_SECONDS = "domain.compile.seconds"
 #: degradation-ladder rung build (trace/compile) seconds
 LADDER_BUILD_SECONDS = "resilience.ladder.build_seconds"
+#: wall seconds per checkpoint commit (gather + write + fsync + rename)
+CHECKPOINT_SAVE_SECONDS = "checkpoint.save.seconds"
+#: wall seconds per checkpoint restore (load + verify + re-scatter)
+CHECKPOINT_RESTORE_SECONDS = "checkpoint.restore.seconds"
 
 ALL_HISTOGRAMS = frozenset({
     STEP_SECONDS,
@@ -119,6 +148,8 @@ ALL_HISTOGRAMS = frozenset({
     SWAP_SECONDS,
     COMPILE_SECONDS,
     LADDER_BUILD_SECONDS,
+    CHECKPOINT_SAVE_SECONDS,
+    CHECKPOINT_RESTORE_SECONDS,
 })
 
 # --- spans (Chrome-trace timeline entries) -----------------------------------
@@ -181,6 +212,20 @@ EVENT_KERNEL_COMPUTE_UNIT = "kernel.compute_unit"
 #: storage=native|bf16, source — same vocabulary as kernel.compute_unit,
 #: where)
 EVENT_KERNEL_STORAGE_DTYPE = "kernel.storage_dtype"
+#: a checkpoint committed (fields: path, step, backend, bytes, seconds,
+#: reason=cadence|final|preempt)
+EVENT_CHECKPOINT_SAVE = "checkpoint.save"
+#: a checkpoint restored (fields: path, step, backend, elastic, seconds)
+EVENT_CHECKPOINT_RESTORE = "checkpoint.restore"
+#: a checkpoint failed validation and the ring fell back past it (fields:
+#: path, why)
+EVENT_CHECKPOINT_FALLBACK = "checkpoint.fallback"
+#: the supervisor restarted from the last valid checkpoint (fields: label,
+#: step, restart, budget, failure_class, error)
+EVENT_SUPERVISOR_RESTART = "supervisor.restart"
+#: the watchdog saw a dispatch exceed its deadline (fields: phase,
+#: deadline_s, abort)
+EVENT_WATCHDOG_STALL = "watchdog.stall"
 
 ALL_EVENTS = frozenset({
     EVENT_COMPILE,
@@ -196,6 +241,11 @@ ALL_EVENTS = frozenset({
     EVENT_STEP_OVERLAP,
     EVENT_KERNEL_COMPUTE_UNIT,
     EVENT_KERNEL_STORAGE_DTYPE,
+    EVENT_CHECKPOINT_SAVE,
+    EVENT_CHECKPOINT_RESTORE,
+    EVENT_CHECKPOINT_FALLBACK,
+    EVENT_SUPERVISOR_RESTART,
+    EVENT_WATCHDOG_STALL,
 })
 
 #: every registered name, any kind — what the lint checks literals against

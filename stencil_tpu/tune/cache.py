@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
 from typing import Optional, Tuple
 
 from stencil_tpu.tune.key import WorkloadKey
@@ -103,8 +102,9 @@ def load(key: WorkloadKey) -> Optional[Tuple[dict, dict]]:
 
 
 def store(key: WorkloadKey, config: dict, meta: Optional[dict] = None) -> str:
-    """Persist the winning config atomically (write-rename: a crashed run
-    must not leave a truncated file a later run would half-parse)."""
+    """Persist the winning config atomically (utils/artifact.py write-rename:
+    a crashed run must not leave a truncated file a later run would
+    half-parse)."""
     jax_v, jaxlib_v = _toolchain()
     doc = {
         "schema": SCHEMA,
@@ -114,19 +114,6 @@ def store(key: WorkloadKey, config: dict, meta: Optional[dict] = None) -> str:
         "config": config,
         "meta": meta or {},
     }
-    d = cache_dir()
-    os.makedirs(d, exist_ok=True)
-    path = path_for(key)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(doc, f, indent=2, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-    return path
+    from stencil_tpu.utils.artifact import atomic_write_json
+
+    return atomic_write_json(path_for(key), doc)

@@ -1,0 +1,25 @@
+# lint-fixture: select=artifact-write rel=stencil_tpu/fake.py expect=clean
+# The sanctioned patterns: atomic helpers for artifacts, reads and
+# append-streams (the JSONL sink contract) untouched.
+import json
+
+from stencil_tpu.utils.artifact import atomic_write, atomic_write_json
+
+
+def dump(path, doc):
+    atomic_write_json(path, doc)
+
+
+def dump_binary(path, payload):
+    with atomic_write(path, "wb") as f:
+        f.write(payload)
+
+
+def read(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def append_event(path, line):
+    with open(path, "a", buffering=1) as f:
+        f.write(line + "\n")

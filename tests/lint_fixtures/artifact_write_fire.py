@@ -1,0 +1,29 @@
+# lint-fixture: select=artifact-write rel=stencil_tpu/fake.py expect=artifact-write,artifact-write,artifact-write,bad-suppression
+# Seeded violations: truncating open modes fire (positional, keyword, and
+# binary), a reasoned suppression silences its write, a bare one fails AND
+# leaves its write flagged.
+import io
+import json
+import os
+
+
+def dump(path, doc):
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def dump_kw(path, text):
+    with io.open(path, mode="w") as f:
+        f.write(text)
+
+
+def dump_bare_suppression(fd):
+    # stencil-lint: disable=artifact-write
+    with os.fdopen(fd, "wb") as f:
+        f.write(b"x")
+
+
+def dump_suppressed(path):
+    # stencil-lint: disable=artifact-write fixture: a deliberately streaming scratch file, not a run artifact
+    with open(path, "w") as f:
+        f.write("scratch")

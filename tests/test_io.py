@@ -1,62 +1,368 @@
-"""Tier-2: checkpoint/restore (both backends) and paraview dumps."""
+"""Tier-1: checkpoint/restore (atomic manifest format, digest validation,
+retention ring, elastic cross-mesh restore) and paraview dumps."""
 
+import json
 import os
 
+import jax
 import numpy as np
 import pytest
 
 from stencil_tpu.domain import DistributedDomain
-from stencil_tpu.io.checkpoint import restore_checkpoint, save_checkpoint
+from stencil_tpu.io import checkpoint as ck
+from stencil_tpu.io.checkpoint import (
+    latest_valid,
+    load_manifest,
+    restore_checkpoint,
+    restore_latest,
+    ring_entries,
+    save_checkpoint,
+    save_to_ring,
+    validate_checkpoint,
+)
 from stencil_tpu.io.paraview import write_paraview
+from stencil_tpu.resilience.taxonomy import CheckpointCorruptError
 
 
-def _make_domain(size=(16, 16, 16)):
+def _make_domain(
+    size=(16, 16, 16),
+    devices=None,
+    quantities=(("q", np.float32),),
+    radius=1,
+    halo_mult=1,
+    storage=None,
+):
     dd = DistributedDomain(*size)
-    dd.set_radius(1)
-    h = dd.add_data("q")
+    dd.set_radius(radius)
+    if devices is not None:
+        dd.set_devices(devices)
+    hs = [dd.add_data(n, dtype=dt) for n, dt in quantities]
+    if halo_mult > 1:
+        dd.set_halo_multiplier(halo_mult)
+    if storage is not None:
+        dd.set_storage(storage)
     dd.realize()
-    dd.init_by_coords(h, lambda x, y, z: x * 1.5 + y * 0.25 + z)
-    return dd, h
+    for i, h in enumerate(hs):
+        if np.dtype(h.dtype) == np.bool_:
+            dd.init_by_coords(h, lambda x, y, z: (x + y + z) % 2 == 0)
+        elif np.issubdtype(np.dtype(h.dtype), np.integer):
+            dd.init_by_coords(h, lambda x, y, z, i=i: (x * 7 + y * 3 + z + i) % 120 - 60)
+        else:
+            dd.init_by_coords(h, lambda x, y, z, i=i: x * 1.5 + y * 0.25 + z + i)
+    return dd, hs
+
+
+def _wipe(dd, hs):
+    for h in hs:
+        if np.dtype(h.dtype) == np.bool_:
+            dd.init_by_coords(h, lambda x, y, z: (x + y + z) < 0)
+        else:
+            dd.init_by_coords(h, lambda x, y, z: 0 * (x + y + z))
+
+
+# --- round-trip matrix -------------------------------------------------------
 
 
 @pytest.mark.parametrize("backend", ["npz", "orbax"])
 def test_checkpoint_roundtrip(tmp_path, backend):
     if backend == "orbax":
         pytest.importorskip("orbax.checkpoint", reason="orbax is optional")
-    dd, h = _make_domain()
-    want = dd.quantity_to_host(h)
+    dd, hs = _make_domain()
+    want = dd.quantity_to_host(hs[0])
     used = save_checkpoint(dd, str(tmp_path / "ckpt"), step=7, backend=backend)
     assert used == backend
 
-    dd2, h2 = _make_domain()
-    dd2.init_by_coords(h2, lambda x, y, z: 0.0 * x)  # wipe
+    dd2, hs2 = _make_domain()
+    _wipe(dd2, hs2)
     step = restore_checkpoint(dd2, str(tmp_path / "ckpt"))
     assert step == 7
-    np.testing.assert_array_equal(dd2.quantity_to_host(h2), want)
+    np.testing.assert_array_equal(dd2.quantity_to_host(hs2[0]), want)
 
 
 def test_checkpoint_uneven_npz(tmp_path):
-    dd, h = _make_domain(size=(15, 17, 13))
-    want = dd.quantity_to_host(h)
+    dd, hs = _make_domain(size=(15, 17, 13))
+    want = dd.quantity_to_host(hs[0])
     save_checkpoint(dd, str(tmp_path / "c"), backend="npz")
-    dd2, h2 = _make_domain(size=(15, 17, 13))
+    dd2, hs2 = _make_domain(size=(15, 17, 13))
+    _wipe(dd2, hs2)
     restore_checkpoint(dd2, str(tmp_path / "c"))
-    np.testing.assert_array_equal(dd2.quantity_to_host(h2), want)
+    np.testing.assert_array_equal(dd2.quantity_to_host(hs2[0]), want)
+
+
+def test_checkpoint_halo_multiplier_shells(tmp_path):
+    """A domain with 2x-multiplied shells round-trips on interiors alone —
+    the shell refills at the next exchange, so shell width is NOT part of
+    the portable representation (a resumed run may even re-plan it)."""
+    dd, hs = _make_domain(halo_mult=2)
+    want = dd.quantity_to_host(hs[0])
+    save_checkpoint(dd, str(tmp_path / "c"), backend="npz")
+    dd2, hs2 = _make_domain(halo_mult=3)  # different shell width on restore
+    _wipe(dd2, hs2)
+    restore_checkpoint(dd2, str(tmp_path / "c"))
+    np.testing.assert_array_equal(dd2.quantity_to_host(hs2[0]), want)
+    assert load_manifest(str(tmp_path / "c"))["run_state"]["halo_multiplier"] == 2
+
+
+def test_checkpoint_bf16_storage_roundtrip(tmp_path):
+    """bf16-storage fields checkpoint at the NATIVE dtype (exact upcast per
+    the PR-7 contract) and restore bitwise into a bf16 domain (every saved
+    value is bf16-representable, so the narrowing cast is exact)."""
+    dd, hs = _make_domain(storage="bf16")
+    assert dd.storage_dtype() == "bf16"
+    want = dd.quantity_to_host(hs[0])
+    assert want.dtype == np.float32  # upcast at readback
+    save_checkpoint(dd, str(tmp_path / "c"), backend="npz")
+    meta = load_manifest(str(tmp_path / "c"))
+    assert meta["run_state"]["storage_dtype"] == "bf16"
+    assert meta["quantities"][0]["dtype"] == "float32"  # portable repr
+
+    dd2, hs2 = _make_domain(storage="bf16")
+    _wipe(dd2, hs2)
+    restore_checkpoint(dd2, str(tmp_path / "c"))
+    np.testing.assert_array_equal(dd2.quantity_to_host(hs2[0]), want)
+    # and elastically into a NATIVE domain: the f32 values are already exact
+    dd3, hs3 = _make_domain()
+    _wipe(dd3, hs3)
+    restore_checkpoint(dd3, str(tmp_path / "c"))
+    np.testing.assert_array_equal(dd3.quantity_to_host(hs3[0]), want)
+
+
+FUSED = (
+    ("f", np.float32),
+    ("d", np.float64),
+    ("i", np.int8),
+    ("b", np.bool_),
+)
+
+
+def test_checkpoint_fused_multi_dtype_domain(tmp_path):
+    """The fused-exchange stress set (f32/f64/int8/bool in one domain)
+    round-trips every quantity bitwise, digests and all."""
+    dd, hs = _make_domain(quantities=FUSED)
+    want = {h.name: dd.quantity_to_host(h) for h in hs}
+    save_checkpoint(dd, str(tmp_path / "c"), backend="npz", step=3)
+    validate_checkpoint(str(tmp_path / "c"))  # digests hold standalone
+    dd2, hs2 = _make_domain(quantities=FUSED)
+    _wipe(dd2, hs2)
+    assert restore_checkpoint(dd2, str(tmp_path / "c")) == 3
+    for h in hs2:
+        np.testing.assert_array_equal(dd2.quantity_to_host(h), want[h.name])
+
+
+@pytest.mark.parametrize("backend", ["npz", "orbax"])
+def test_checkpoint_elastic_mesh_a_to_mesh_b(tmp_path, backend):
+    """THE elastic-restore pin: save on mesh A (8 devices, [2,2,2]),
+    restore onto mesh B (2 devices, [2,1,1]) — equality to the source
+    field, both backends (orbax re-scatters through the manifest geometry
+    instead of its historical same-topology requirement)."""
+    if backend == "orbax":
+        pytest.importorskip("orbax.checkpoint", reason="orbax is optional")
+    dd, hs = _make_domain(devices=jax.devices()[:8])
+    assert tuple(dd.placement.dim()) == (2, 2, 2)
+    want = dd.quantity_to_host(hs[0])
+    save_checkpoint(dd, str(tmp_path / "c"), step=5, backend=backend)
+
+    dd2, hs2 = _make_domain(devices=jax.devices()[:2])
+    assert tuple(dd2.placement.dim()) != (2, 2, 2)
+    _wipe(dd2, hs2)
+    assert restore_checkpoint(dd2, str(tmp_path / "c")) == 5
+    np.testing.assert_array_equal(dd2.quantity_to_host(hs2[0]), want)
+
+
+def test_checkpoint_elastic_uneven_npz(tmp_path):
+    """Elastic restore with padded (uneven) shards on BOTH sides."""
+    dd, hs = _make_domain(size=(15, 17, 13), devices=jax.devices()[:8])
+    want = dd.quantity_to_host(hs[0])
+    save_checkpoint(dd, str(tmp_path / "c"), backend="npz")
+    dd2, hs2 = _make_domain(size=(15, 17, 13), devices=jax.devices()[:3])
+    _wipe(dd2, hs2)
+    restore_checkpoint(dd2, str(tmp_path / "c"))
+    np.testing.assert_array_equal(dd2.quantity_to_host(hs2[0]), want)
+
+
+# --- rejection: clear errors, never a stack trace mid-restore ----------------
+
+
+def test_restore_missing_manifest_rejects_clearly(tmp_path):
+    dd, _ = _make_domain()
+    d = tmp_path / "notackpt"
+    d.mkdir()
+    with pytest.raises(CheckpointCorruptError, match="missing MANIFEST"):
+        restore_checkpoint(dd, str(d))
+    with pytest.raises(CheckpointCorruptError, match="no such directory"):
+        restore_checkpoint(dd, str(tmp_path / "absent"))
+
+
+def test_restore_legacy_meta_json_named_explicitly(tmp_path):
+    """The pre-atomic format is identified BY NAME, not as generic
+    corruption."""
+    dd, _ = _make_domain()
+    d = tmp_path / "legacy"
+    d.mkdir()
+    (d / "meta.json").write_text(json.dumps({"size": [16, 16, 16], "step": 1}))
+    with pytest.raises(CheckpointCorruptError, match="pre-atomic"):
+        restore_checkpoint(dd, str(d))
+
+
+def test_restore_partial_manifest_rejects(tmp_path):
+    dd, _ = _make_domain()
+    d = tmp_path / "partial"
+    d.mkdir()
+    (d / ck.MANIFEST).write_text(json.dumps({"schema": ck.SCHEMA, "size": [16, 16, 16]}))
+    with pytest.raises(CheckpointCorruptError, match="missing 'step'"):
+        restore_checkpoint(dd, str(d))
+    (d / ck.MANIFEST).write_text("{trunca")
+    with pytest.raises(CheckpointCorruptError, match="unreadable manifest"):
+        restore_checkpoint(dd, str(d))
+
+
+def test_restore_missing_state_rejects(tmp_path):
+    dd, _ = _make_domain()
+    save_checkpoint(dd, str(tmp_path / "c"), backend="npz")
+    os.unlink(tmp_path / "c" / "state.npz")
+    with pytest.raises(CheckpointCorruptError, match="missing state.npz"):
+        restore_checkpoint(dd, str(tmp_path / "c"))
+
+
+def test_restore_digest_mismatch_keeps_previous_state(tmp_path):
+    """A flipped byte in the state is caught by the sha256 BEFORE anything
+    is installed: the domain still holds its pre-restore field."""
+    dd, hs = _make_domain()
+    save_checkpoint(dd, str(tmp_path / "c"), backend="npz")
+    # corrupt the npz payload in place (re-zip so the container stays valid)
+    spath = tmp_path / "c" / "state.npz"
+    with np.load(spath) as data:
+        arrs = {k: data[k].copy() for k in data.files}
+    arrs["q"][0, 0, 0] += 1.0
+    np.savez(spath, **arrs)
+    dd2, hs2 = _make_domain()
+    _wipe(dd2, hs2)
+    before = dd2.quantity_to_host(hs2[0])
+    with pytest.raises(CheckpointCorruptError, match="digest mismatch"):
+        restore_checkpoint(dd2, str(tmp_path / "c"))
+    np.testing.assert_array_equal(dd2.quantity_to_host(hs2[0]), before)
+    # validate_checkpoint flags it standalone too
+    with pytest.raises(CheckpointCorruptError, match="digest mismatch"):
+        validate_checkpoint(str(tmp_path / "c"))
 
 
 def test_checkpoint_size_mismatch_raises(tmp_path):
     dd, _ = _make_domain()
     save_checkpoint(dd, str(tmp_path / "c"), backend="npz")
     other, _ = _make_domain(size=(8, 8, 8))
-    with pytest.raises(ValueError):
+    with pytest.raises(ValueError, match="size"):
         restore_checkpoint(other, str(tmp_path / "c"))
 
 
+def test_checkpoint_quantity_mismatch_raises(tmp_path):
+    dd, _ = _make_domain()
+    save_checkpoint(dd, str(tmp_path / "c"), backend="npz")
+    other, _ = _make_domain(quantities=(("other", np.float32),))
+    with pytest.raises(ValueError, match="quantities"):
+        restore_checkpoint(other, str(tmp_path / "c"))
+
+
+def test_save_without_digests_restores_unverified(tmp_path):
+    """``digests=False`` (the pod-scale orbax knob) records null digests;
+    restores then skip byte verification for that checkpoint but still
+    load correctly."""
+    dd, hs = _make_domain()
+    want = dd.quantity_to_host(hs[0])
+    save_checkpoint(dd, str(tmp_path / "c"), backend="npz", digests=False)
+    meta = validate_checkpoint(str(tmp_path / "c"))  # structure still checked
+    assert meta["quantities"][0]["digest"] is None
+    dd2, hs2 = _make_domain()
+    _wipe(dd2, hs2)
+    restore_checkpoint(dd2, str(tmp_path / "c"))
+    np.testing.assert_array_equal(dd2.quantity_to_host(hs2[0]), want)
+
+
+def test_save_overwrites_atomically(tmp_path):
+    """Re-saving over an existing checkpoint replaces it wholesale (the
+    aside-rename dance): the new manifest step wins, no stale files mix."""
+    dd, _ = _make_domain()
+    save_checkpoint(dd, str(tmp_path / "c"), step=1, backend="npz")
+    save_checkpoint(dd, str(tmp_path / "c"), step=2, backend="npz")
+    assert load_manifest(str(tmp_path / "c"))["step"] == 2
+    assert validate_checkpoint(str(tmp_path / "c"))["step"] == 2
+
+
+# --- retention ring ----------------------------------------------------------
+
+
+def test_ring_retention_and_fallback(tmp_path):
+    dd, _ = _make_domain()
+    root = str(tmp_path / "ring")
+    for step in (4, 8, 12, 16):
+        save_to_ring(dd, root, step, keep=2, backend="npz")
+    assert [s for s, _ in ring_entries(root)] == [12, 16]
+    # newest valid wins
+    path, meta = latest_valid(root)
+    assert meta["step"] == 16
+    # corrupt the newest -> falls back to the previous valid entry
+    with open(os.path.join(path, "state.npz"), "r+b") as f:
+        f.seek(100)
+        f.write(b"XXXX")
+    path2, meta2 = latest_valid(root)
+    assert meta2["step"] == 12 and path2 != path
+    # all corrupt -> None
+    os.unlink(os.path.join(path2, ck.MANIFEST))
+    assert latest_valid(root) is None
+
+
+def test_restore_latest_falls_back_past_restore_time_corruption(tmp_path):
+    """``restore_latest`` (the supervisor's resume path) falls back when
+    the newest entry fails AT RESTORE — the rung that covers orbax bit rot,
+    which structural validation cannot see — and installs the older state
+    whole (never the half-restored newest)."""
+    dd, hs = _make_domain()
+    root = str(tmp_path / "ring")
+    save_to_ring(dd, root, 4, keep=3, backend="npz")
+    older = dd.quantity_to_host(hs[0])
+    dd.init_by_coords(hs[0], lambda x, y, z: 2.0 * x + y + 0.5 * z)
+    save_to_ring(dd, root, 8, keep=3, backend="npz")
+    # corrupt the newest entry's payload in place (container stays valid)
+    spath = os.path.join(ck.ring_path(root, 8), "state.npz")
+    with np.load(spath) as data:
+        arrs = {k: data[k].copy() for k in data.files}
+    arrs["q"][0, 0, 0] += 1.0
+    np.savez(spath, **arrs)
+    dd2, hs2 = _make_domain()
+    _wipe(dd2, hs2)
+    found = restore_latest(dd2, root)
+    assert found is not None and found[2] == 4
+    np.testing.assert_array_equal(dd2.quantity_to_host(hs2[0]), older)
+
+
+def test_ring_prune_sweeps_stale_stage_dirs(tmp_path):
+    """A SIGKILLed save's stage/aside survivors are swept at the next ring
+    save — they are full-checkpoint-sized and same-pid cleanup never ran."""
+    dd, _ = _make_domain()
+    root = str(tmp_path / "ring")
+    save_to_ring(dd, root, 4, keep=3, backend="npz")
+    stale = os.path.join(root, "ckpt-000000000008.tmp.99999")
+    os.makedirs(stale)
+    save_to_ring(dd, root, 8, keep=3, backend="npz")
+    assert not os.path.exists(stale)
+
+
+def test_ring_ignores_foreign_and_stage_dirs(tmp_path):
+    dd, _ = _make_domain()
+    root = str(tmp_path / "ring")
+    save_to_ring(dd, root, 4, keep=3, backend="npz")
+    os.makedirs(os.path.join(root, "ckpt-000000000008.tmp.123"))
+    os.makedirs(os.path.join(root, "notackpt"))
+    assert [s for s, _ in ring_entries(root)] == [4]
+
+
+# --- paraview (unchanged format) ---------------------------------------------
+
+
 def test_write_paraview(tmp_path):
-    dd, h = _make_domain(size=(8, 8, 8))
+    dd, hs = _make_domain(size=(8, 8, 8))
     prefix = str(tmp_path / "out")
     write_paraview(dd, prefix)
-    files = sorted(os.listdir(tmp_path))
+    files = sorted(f for f in os.listdir(tmp_path) if f.startswith("out"))
     assert len(files) == dd.num_subdomains()
     # header + one row per interior point, z-major (src/stencil.cu:894-935)
     n = dd.subdomain_size()

@@ -112,6 +112,27 @@ class TestClassify:
         assert classify(ValueError("shape mismatch")) is FailureClass.FATAL
         assert classify(KeyError("temp")) is FailureClass.FATAL
 
+    def test_preemption_never_transient(self):
+        """THE preemption pin: KeyboardInterrupt / SIGTERM-driven
+        termination classifies PREEMPTED, so the retry loop can never
+        swallow a preemption notice by re-running the work — even when the
+        notice's wording brushes the transient marker list."""
+        from stencil_tpu.resilience.taxonomy import PreemptionError, StallError
+
+        assert classify(KeyboardInterrupt()) is FailureClass.PREEMPTED
+        assert classify(PreemptionError("SIGTERM")) is FailureClass.PREEMPTED
+        # typed class wins over substring matching: this wording contains
+        # TWO transient markers and must still classify PREEMPTED
+        notice = PreemptionError("deadline exceeded — node reclaimed, try again later")
+        assert classify(notice) is FailureClass.PREEMPTED
+        assert classify(StallError("dispatch:jacobi", 30.0)) is FailureClass.STALL
+
+    def test_preempted_and_stall_never_degrade(self):
+        from stencil_tpu.resilience.taxonomy import is_degradable
+
+        assert not is_degradable(FailureClass.PREEMPTED)
+        assert not is_degradable(FailureClass.STALL)
+
     def test_user_kernel_bugs_stay_fatal(self):
         """Ordinary Python errors whose wording brushes the marker lists must
         NOT be misread as degradable/retryable — a programming bug should
@@ -207,6 +228,23 @@ class TestFaultPlan:
             with pytest.raises(ValueError, match="STENCIL_FAULT_PLAN"):
                 inject.FaultPlan.parse(bad)
 
+    def test_skip_suffix_delays_firing(self):
+        """'@K' lets K matching hook calls pass before the entry arms — the
+        chaos harness's 'die at the K-th dispatch' primitive."""
+        p = inject.FaultPlan.parse("dispatch:fatal:jacobi@2*1")
+        p.fire("dispatch", "jacobi")  # pass 1
+        p.fire("dispatch", "jacobi")  # pass 2
+        with pytest.raises(RuntimeError, match="injected fatal"):
+            p.fire("dispatch", "jacobi")
+        p.fire("dispatch", "jacobi")  # spent
+
+    def test_process_kill_classes_parse(self):
+        """sigkill/sigterm entries parse (firing them would signal THIS
+        process — the subprocess soak covers delivery, scripts/run_soak.py)."""
+        p = inject.FaultPlan.parse("dispatch:sigkill:jacobi@7,dispatch:sigterm:x*2")
+        assert p.pending() == 3
+        p.fire("dispatch", "other")  # label mismatch: nothing fires
+
     def test_env_plan_reparsed_on_change(self, monkeypatch):
         monkeypatch.setenv("STENCIL_FAULT_PLAN", "dispatch:fatal*1")
         with pytest.raises(RuntimeError, match="injected fatal"):
@@ -276,6 +314,26 @@ class TestRetry:
                 sleep=lambda _: None,
             )
         assert calls["n"] == 1  # the retry was REFUSED, not exhausted
+
+    def test_preemption_is_never_retried(self):
+        """The retry loop re-raises a preemption on the FIRST attempt: a
+        burning preemption deadline must not be spent on backoff sleeps
+        (exact satellite behavior, paired with the classify pin above)."""
+        from stencil_tpu.resilience.taxonomy import PreemptionError
+
+        calls = {"n": 0}
+
+        def preempted():
+            calls["n"] += 1
+            raise PreemptionError("SIGTERM")
+
+        with pytest.raises(PreemptionError):
+            execute_with_retry(
+                preempted,
+                policy=RetryPolicy(max_retries=5, backoff_base_s=0.0),
+                sleep=lambda _: None,
+            )
+        assert calls["n"] == 1
 
     def test_buffers_live_on_real_arrays(self):
         a = jnp.zeros((4,))

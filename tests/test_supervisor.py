@@ -1,0 +1,343 @@
+"""Tier-1: the long-run survival layer — dispatch watchdog, checkpoint/
+resume supervisor (restart budget, preemption exit), driver wiring, and the
+in-process kill/resume bitwise-continuity pin.  The subprocess chaos soak
+(real SIGKILL/SIGTERM delivery, scripts/run_soak.py) is tier-2 ``slow``."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from stencil_tpu import telemetry
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.io.checkpoint import latest_valid, ring_entries
+from stencil_tpu.models.jacobi import Jacobi3D
+from stencil_tpu.resilience import inject
+from stencil_tpu.resilience.supervisor import (
+    EXIT_RESUMABLE,
+    RunSupervisor,
+    SupervisorConfig,
+)
+from stencil_tpu.resilience.taxonomy import FailureClass, StallError, classify
+from stencil_tpu.resilience.watchdog import DispatchWatchdog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    yield
+    inject.set_plan(None)
+
+
+def _model(steps_done: int = 0) -> Jacobi3D:
+    m = Jacobi3D(16, 16, 16, devices=jax.devices()[:8])
+    m.realize()
+    if steps_done:
+        m.step(steps_done)
+    return m
+
+
+def _config(tmp_path, **kw) -> SupervisorConfig:
+    kw.setdefault("dir", str(tmp_path / "ring"))
+    kw.setdefault("every_steps", 4)
+    kw.setdefault("backend", "npz")
+    return SupervisorConfig(**kw)
+
+
+# --- dispatch watchdog -------------------------------------------------------
+
+
+class TestWatchdog:
+    def test_observe_mode_never_relabels_ctrl_c(self):
+        """Observe-only mode: a deadline trip is recorded, but a LATER user
+        Ctrl-C during a watched dispatch must stay a KeyboardInterrupt —
+        the stale unclaimed stall may not convert it to STALL."""
+        dd = DistributedDomain(8, 8, 8)
+        dd.set_radius(1)
+        dd.set_devices(jax.devices()[:1])
+        dd.add_data("q")
+        dd.realize()
+        wd = DispatchWatchdog(0.05, abort=False)
+        dd.set_watchdog(wd)
+
+        def slow_then_interrupted(curr, steps):
+            time.sleep(0.2)  # trips the observe-only deadline...
+            raise KeyboardInterrupt  # ...then the USER presses Ctrl-C
+
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                dd.run_step(slow_then_interrupted, 1, label="obs")
+        finally:
+            dd.set_watchdog(None)
+            wd.close()
+
+    def test_observe_mode_records_stall(self):
+        wd = DispatchWatchdog(0.05, abort=False)
+        try:
+            with wd.watch("dispatch:test"):
+                time.sleep(0.2)
+            stall = wd.take_stall()
+            assert stall is not None and stall.phase == "dispatch:test"
+            assert classify(stall) is FailureClass.STALL
+            assert wd.take_stall() is None  # claimed once
+        finally:
+            wd.close()
+
+    def test_abort_mode_interrupts_the_dispatch(self):
+        wd = DispatchWatchdog(0.05, abort=True)
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                with wd.watch("dispatch:slow"):
+                    time.sleep(5.0)
+            assert wd.take_stall() is not None
+        finally:
+            wd.close()
+
+    def test_fast_dispatches_never_trip(self):
+        wd = DispatchWatchdog(0.5)
+        try:
+            for _ in range(3):
+                with wd.watch("fast"):
+                    time.sleep(0.005)
+            time.sleep(0.05)
+            assert wd.take_stall() is None
+        finally:
+            wd.close()
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.delenv("STENCIL_WATCHDOG_S", raising=False)
+        assert DispatchWatchdog.from_env() is None
+        monkeypatch.setenv("STENCIL_WATCHDOG_S", "30")
+        monkeypatch.setenv("STENCIL_WATCHDOG_ABORT", "1")
+        wd = DispatchWatchdog.from_env()
+        assert wd is not None and wd.deadline_s == 30.0 and wd.abort
+        monkeypatch.setenv("STENCIL_WATCHDOG_S", "soon")
+        with pytest.raises(ValueError, match="STENCIL_WATCHDOG_S"):
+            DispatchWatchdog.from_env()
+
+    def test_domain_converts_abort_to_classified_stall(self):
+        """A watchdog-aborted dispatch surfaces from ``run_step`` as a
+        classified StallError — never mistaken for a user Ctrl-C."""
+        dd = DistributedDomain(8, 8, 8)
+        dd.set_radius(1)
+        dd.set_devices(jax.devices()[:1])
+        dd.add_data("q")
+        dd.realize()
+        wd = DispatchWatchdog(0.05, abort=True)
+        dd.set_watchdog(wd)
+
+        def wedged(curr, steps):
+            time.sleep(5.0)
+            return curr
+
+        try:
+            with pytest.raises(StallError, match="watchdog deadline"):
+                dd.run_step(wedged, 1, label="wedged")
+        finally:
+            dd.set_watchdog(None)
+            wd.close()
+
+
+# --- supervisor --------------------------------------------------------------
+
+
+class TestSupervisor:
+    def test_kill_point_bitwise_continuity(self, tmp_path):
+        """THE tier-1 kill/resume pin (one kill point, in-process): a FATAL
+        at a mid-run dispatch restarts from the last ring checkpoint and the
+        final field is BITWISE identical to an unkilled run of the same
+        step count."""
+        want = _model(12).temperature()
+        m = _model()
+        sup = RunSupervisor(m.dd, _config(tmp_path), label="jacobi")
+        inject.set_plan("dispatch:fatal:jacobi@6*1")  # die at the 7th dispatch
+        out = sup.run(12, advance=lambda n: m.step(n), chunk=1)
+        assert out.completed and out.restarts == 1
+        np.testing.assert_array_equal(m.temperature(), want)
+
+    def test_sigterm_preempts_resumes_bitwise(self, tmp_path):
+        """An injected REAL SIGTERM mid-run: final checkpoint, resumable
+        exit code; a fresh process resumes and finishes bitwise identical
+        to the unkilled run."""
+        want = _model(12).temperature()
+        m = _model()
+        sup = RunSupervisor(m.dd, _config(tmp_path), label="jacobi")
+        inject.set_plan("dispatch:sigterm:jacobi@5*1")
+        out = sup.run(12, advance=lambda n: m.step(n), chunk=1)
+        assert out.preempted and out.exit_code == EXIT_RESUMABLE
+        assert out.step == 6  # the signal landed during dispatch 6's iteration
+        inject.set_plan(None)
+        # "new process": fresh model, resume from the preempt checkpoint
+        m2 = _model()
+        sup2 = RunSupervisor(m2.dd, _config(tmp_path), label="jacobi")
+        out2 = sup2.run(12, advance=lambda n: m2.step(n), chunk=1)
+        assert out2.completed and out2.step == 12 and out2.restarts == 0
+        np.testing.assert_array_equal(m2.temperature(), want)
+
+    def test_mid_chunk_preemption_skips_stale_final_checkpoint(self, tmp_path):
+        """A preemption that interrupts a chunk mid-flight leaves the domain
+        an unknown number of iterations past the step counter: the final
+        checkpoint is SKIPPED (its step label would be stale) and the last
+        ring entry stands — resume re-runs from there, still bitwise."""
+        want = _model(12).temperature()
+        m = _model()
+        cfg = _config(tmp_path, every_steps=4)
+        sup = RunSupervisor(m.dd, cfg, label="jacobi")
+
+        def advance(n):
+            m.step(min(n, 2))  # partial progress...
+            raise KeyboardInterrupt  # ...then the preemption lands
+
+        out = sup.run(12, advance, chunk=12)
+        assert out.preempted and out.exit_code == EXIT_RESUMABLE
+        # only the step-0 anchor exists; no checkpoint claims phantom steps
+        assert [s for s, _ in ring_entries(cfg.dir)] == [0]
+        m2 = _model()
+        out2 = RunSupervisor(m2.dd, cfg, label="jacobi").run(
+            12, advance=lambda n: m2.step(n), chunk=1
+        )
+        assert out2.completed
+        np.testing.assert_array_equal(m2.temperature(), want)
+
+    def test_restart_budget_exhausts_to_the_caller(self, tmp_path):
+        m = _model()
+        sup = RunSupervisor(m.dd, _config(tmp_path, max_restarts=1), label="jacobi")
+        inject.set_plan("dispatch:fatal:jacobi*3")  # outlasts the budget
+        with pytest.raises(RuntimeError, match="injected fatal"):
+            sup.run(8, advance=lambda n: m.step(n), chunk=1)
+
+    def test_divergence_is_never_restarted(self, tmp_path):
+        """Restarting deterministic numerics that diverged would diverge
+        again — DIVERGENCE propagates through the supervisor untouched."""
+        m = _model()
+        sup = RunSupervisor(m.dd, _config(tmp_path, max_restarts=5), label="jacobi")
+        inject.set_plan("dispatch:divergence:jacobi@2*1")
+        from stencil_tpu.resilience.taxonomy import DivergenceError
+
+        with pytest.raises(DivergenceError):
+            sup.run(8, advance=lambda n: m.step(n), chunk=1)
+
+    def test_run_state_round_trips(self, tmp_path):
+        m = _model()
+        sup = RunSupervisor(
+            m.dd,
+            _config(tmp_path),
+            label="jacobi",
+            run_state=lambda: {"tuned": {"m": 3}, "note": "x"},
+        )
+        out = sup.run(4, advance=lambda n: m.step(n), chunk=1)
+        assert out.completed
+        m2 = _model()
+        sup2 = RunSupervisor(m2.dd, _config(tmp_path), label="jacobi")
+        assert sup2.resume() == 4
+        assert sup2.last_run_state["tuned"] == {"m": 3}
+        assert sup2.last_run_state["storage_dtype"] == "native"
+
+    def test_wallclock_cadence(self, tmp_path):
+        m = _model()
+        cfg = _config(tmp_path, every_steps=0, every_seconds=0.0001)
+        sup = RunSupervisor(m.dd, cfg, label="jacobi")
+        out = sup.run(3, advance=lambda n: m.step(n), chunk=1)
+        assert out.completed
+        # initial anchor + >= 1 wall-clock cadence save + final
+        steps = [s for s, _ in ring_entries(cfg.dir)]
+        assert steps[-1] == 3 and len(steps) >= 2
+
+    def test_config_from_env(self, monkeypatch):
+        monkeypatch.delenv("STENCIL_CHECKPOINT_DIR", raising=False)
+        assert SupervisorConfig.from_env() is None
+        monkeypatch.setenv("STENCIL_CHECKPOINT_DIR", "/tmp/x")
+        monkeypatch.setenv("STENCIL_CHECKPOINT_EVERY", "50")
+        monkeypatch.setenv("STENCIL_CHECKPOINT_KEEP", "5")
+        monkeypatch.setenv("STENCIL_SUPERVISOR_RESTARTS", "7")
+        cfg = SupervisorConfig.from_env()
+        assert cfg == SupervisorConfig(
+            dir="/tmp/x", every_steps=50, keep=5, max_restarts=7
+        )
+        monkeypatch.setenv("STENCIL_CHECKPOINT_EVERY", "often")
+        with pytest.raises(ValueError, match="STENCIL_CHECKPOINT_EVERY"):
+            SupervisorConfig.from_env()
+
+    def test_counters_seeded_in_snapshot(self):
+        snap = telemetry.snapshot()
+        for name in (
+            "checkpoint.saves",
+            "checkpoint.save.bytes",
+            "checkpoint.restores",
+            "checkpoint.invalid",
+            "supervisor.restarts",
+            "watchdog.stalls",
+        ):
+            assert name in snap["counters"], name
+
+
+# --- driver wiring -----------------------------------------------------------
+
+
+class TestDriverWiring:
+    def test_jacobi3d_checkpoint_flags(self, tmp_path, capsys):
+        """--checkpoint-dir/--checkpoint-every/--resume through bin/_common:
+        a run leaves a ring with a final entry; a --resume rerun of the
+        completed run is a no-op that exits 0."""
+        from stencil_tpu.bin.jacobi3d import main
+
+        ring = str(tmp_path / "ring")
+        argv = [
+            "16", "16", "16", "--no-weak-scale", "--iters", "4",
+            "--kernel-impl", "jnp",
+            "--checkpoint-dir", ring, "--checkpoint-every", "2",
+        ]
+        assert main(argv) == 0
+        capsys.readouterr()
+        found = latest_valid(ring)
+        assert found is not None and found[1]["step"] == 4
+        assert found[1]["run_state"]["model"] == "jacobi3d"
+        assert main(argv + ["--resume"]) == 0  # nothing left to do
+        found2 = latest_valid(ring)
+        assert found2 is not None and found2[1]["step"] == 4
+
+
+# --- the subprocess chaos soak (tier-2) --------------------------------------
+
+
+@pytest.mark.slow
+def test_run_soak_kill_resume_chain():
+    """The full chaos proof in subprocesses: >= 3 seeded kills (SIGKILL and
+    SIGTERM delivered by the in-process fault hooks), a resume after each,
+    and a final field bitwise identical to the unkilled reference —
+    scripts/run_soak.py --dryrun, exactly as the acceptance criteria run it."""
+    import tempfile
+
+    out_dir = tempfile.mkdtemp(prefix="stencil_soak_test_")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "run_soak.py"),
+            "--dryrun",
+            "--iters",
+            "12",
+            "--checkpoint-every",
+            "3",
+            "--kills",
+            "3",
+            "--out-dir",
+            out_dir,
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    doc = json.loads(open(os.path.join(out_dir, "soak_summary.json")).read())
+    assert doc["bitwise_identical"] is True
+    assert len(doc["kills"]) == 3
+    signals = {k["signal"] for k in doc["kills"]}
+    assert signals == {"sigkill", "sigterm"}
+    assert doc["final_step"]["chaos"] == doc["final_step"]["ref"] == 12

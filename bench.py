@@ -167,7 +167,35 @@ def mxu_vs_vpu_ab(size: int, k: int, interpret: bool, rt: float,
     return section
 
 
-def main() -> None:
+def build_parser():
+    """Flag surface (the no-flag invocation is byte-identical to the
+    historical ``python bench.py``): ``--ledger`` appends the measured
+    headline to the perf ledger (scripts/perf_ledger.py), ``--profile-dir``
+    captures a ``jax.profiler`` trace of the headline measurement and
+    embeds a per-phase ``roofline`` section in the artifact
+    (docs/observability.md "Roofline reports")."""
+    import argparse
+
+    p = argparse.ArgumentParser("bench")
+    p.add_argument(
+        "--ledger",
+        default=None,
+        metavar="PATH",
+        help="append the measured headline to this perf-ledger JSONL "
+        "(see scripts/perf_ledger.py)",
+    )
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler trace of the headline rounds and "
+        "embed a per-phase roofline section (degrades to a warning on "
+        "backends without a profiler)",
+    )
+    return p
+
+
+def main(argv=None) -> None:
     import statistics as _stats
 
     import jax
@@ -175,9 +203,12 @@ def main() -> None:
 
     from stencil_tpu import tune
     from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.telemetry.device import ProfileCapture
     from stencil_tpu.tune.trial import measure_alternating
     from stencil_tpu.utils.config import env_bool, env_int
 
+    args = build_parser().parse_args(argv)
+    prof = ProfileCapture.from_env(dir=args.profile_dir)
     dev = jax.devices()[0]
     size = env_int("STENCIL_BENCH_SIZE", 512, minimum=8)
     interpret = env_bool("STENCIL_BENCH_INTERPRET", False)
@@ -270,7 +301,15 @@ def main() -> None:
         inners.append(ex_iters)
     for run, n in zip(runs, inners):
         run(n)  # warm + compile at the timed static count
-    rounds = measure_alternating(runs, inners, rt, reps)
+    if prof is not None:
+        # device-truth capture of the steady-state headline rounds: the
+        # captured timing rides the roofline section, not the headline
+        # (the headline numbers come from the same rounds either way —
+        # profiler overhead is the price of a profiled run)
+        with prof.maybe(0):
+            rounds = measure_alternating(runs, inners, rt, reps)
+    else:
+        rounds = measure_alternating(runs, inners, rt, reps)
     dt = _stats.median(rounds[0])
     mcells_per_s = cells / dt / 1e6
     if ex_model is not None:
@@ -378,13 +417,54 @@ def main() -> None:
     if telemetry.enabled():
         result["telemetry"] = telemetry.snapshot()
 
+    # per-phase roofline from the device-profile capture (--profile-dir):
+    # measured device time per named scope joined with the analytic
+    # counters, against THIS chip's measured copy bandwidth.  Best-effort —
+    # a backend without a profiler left no trace, and the headline must
+    # never depend on the observability section.
+    if prof is not None and prof.captures:
+        try:
+            from stencil_tpu.telemetry.roofline import capture_report
+
+            report = capture_report(
+                prof, chip=str(dev.device_kind), measured_hbm_gbps=copy_gbps
+            )
+            if report is not None:
+                result["roofline"] = report
+            else:
+                print(
+                    f"profile: no device rows under {prof.dir} (backend "
+                    "without a device profiler?) — no roofline section",
+                    file=sys.stderr,
+                )
+        except Exception as e:  # noqa: BLE001 — observability, not a dep
+            print(f"roofline section failed (omitted): {e!r}", file=sys.stderr)
+
     print(json.dumps(result))
+    if args.ledger:
+        # AFTER the artifact line, same artifact-first rule: a ledger write
+        # failure must not discard the measured headline
+        try:
+            from stencil_tpu.telemetry import ledger as _ledger
+
+            n = _ledger.append_entries(
+                args.ledger, [_ledger.entry_from_bench_result(result)]
+            )
+            print(f"ledger: {n} entries appended to {args.ledger}", file=sys.stderr)
+        except OSError as e:
+            print(f"ledger append failed: {e!r}", file=sys.stderr)
     if telemetry.enabled():
         # AFTER the artifact line: a full disk / vanished dir writing the
         # trace must not discard the measured headline JSON (the same
         # artifact-first rule as the astaroth section above)
         try:
-            telemetry.write_artifacts()
+            arts = telemetry.write_artifacts()
+            if prof is not None and prof.captures and arts.get("trace"):
+                # device rows onto the host timeline — AFTER the final
+                # host-trace dump so nothing re-dumps over the merge
+                from stencil_tpu.telemetry.device import merge_into_chrome_trace
+
+                merge_into_chrome_trace(arts["trace"], prof.dir)
         except OSError as e:
             print(f"telemetry artifact write failed: {e!r}", file=sys.stderr)
     if ast_error is not None:

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Per-phase roofline report from a telemetry directory.
+
+The table the PERF_NOTES break-even models (VPU wall, split-step overlap,
+zpack) previously required a human to assemble: measured device time per
+phase joined with the analytic counters into achieved GB/s / GFLOP/s and
+the fraction of the chip roofline, per phase.
+
+Inputs, all from one telemetry dir (a run with ``STENCIL_TELEMETRY_DIR``
+set and — for device truth — ``--profile-dir`` pointing inside it):
+
+* ``metrics_<rank>.json`` (written by ``telemetry.write_artifacts``) or an
+  explicit ``--metrics`` snapshot: the analytic counters.
+* ``jax.profiler`` trace dumps (``*.trace.json[.gz]``, searched
+  recursively; ``--profile-dir`` narrows the search): device rows.
+* ``trace_<rank>.json`` (the host Chrome trace): the HOST-span fallback
+  when no device trace exists (CPU dryrun containers) — the report is
+  tagged ``"source": "host"`` because async dispatch wall-clock is not
+  device truth; and with ``--merge``, the file the device rows are merged
+  into so Perfetto shows both on one timeline.
+
+Outputs: ``roofline.json`` + ``roofline.md`` in the telemetry dir (or
+``--out-json`` / ``--out-md``).
+
+    python scripts/perf_report.py /tmp/telem --chip "TPU v5e" --merge
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# runnable as `python scripts/perf_report.py` from anywhere: the telemetry
+# parsers are jax-free stencil_tpu modules imported from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "perf_report",
+        description="per-phase roofline from a telemetry dir (see module docstring)",
+    )
+    p.add_argument("dir", help="telemetry directory (metrics + traces)")
+    p.add_argument(
+        "--metrics",
+        default=None,
+        metavar="PATH",
+        help="metrics snapshot JSON (default: newest metrics_*.json in DIR)",
+    )
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        metavar="DIR",
+        help="where to look for jax.profiler trace dumps (default: DIR, searched recursively)",
+    )
+    p.add_argument(
+        "--chip",
+        default=None,
+        help="device kind for the peak table (e.g. 'TPU v5e'; default: "
+        "the snapshot carries no chip — achieved rates only)",
+    )
+    p.add_argument(
+        "--hbm-gbps",
+        type=float,
+        default=None,
+        help="measured copy bandwidth to use as the HBM roofline "
+        "(bench.py's chip_copy_gbps)",
+    )
+    p.add_argument(
+        "--merge",
+        action="store_true",
+        help="also merge the device rows into DIR's host Chrome trace "
+        "(trace_*.json) so Perfetto shows one timeline",
+    )
+    p.add_argument("--out-json", default=None, metavar="PATH")
+    p.add_argument("--out-md", default=None, metavar="PATH")
+    return p
+
+
+def _load_metrics(args) -> dict:
+    path = args.metrics
+    if path is None:
+        cands = sorted(
+            glob.glob(os.path.join(args.dir, "metrics_*.json")),
+            key=os.path.getmtime,
+        )
+        path = cands[-1] if cands else None
+    if path is None:
+        print("no metrics snapshot found (counters will be absent)", file=sys.stderr)
+        return {}
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _host_attribution(host_trace: str) -> dict:
+    """Host-span fallback: sum span durations per name from the Chrome
+    trace — same shape as the device attribution, tagged by the caller."""
+    from stencil_tpu.telemetry.device import attribute_device_time, load_trace_events
+
+    return attribute_device_time(load_trace_events(host_trace))
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from stencil_tpu.telemetry.device import (
+        attribute_device_time,
+        find_trace_files,
+        load_trace_events,
+        merge_device_rows,
+    )
+    from stencil_tpu.telemetry.roofline import render_markdown, roofline_report
+    from stencil_tpu.utils.artifact import atomic_write_json, atomic_write_text
+
+    snapshot = _load_metrics(args)
+    profile_dir = args.profile_dir or args.dir
+    host_traces = sorted(glob.glob(os.path.join(args.dir, "trace_*.json")))
+    # the host chrome trace is not a profiler dump — exclude it from the
+    # device-trace search (find_trace_files only matches *.trace.json[.gz],
+    # so the patterns are already disjoint; this is belt and braces)
+    device_traces = [t for t in find_trace_files(profile_dir) if t not in host_traces]
+
+    attribution, source = None, "device"
+    if device_traces:
+        events = load_trace_events(device_traces[0])
+        if events:
+            attribution = attribute_device_time(events)
+            if attribution["_total"]["events"] == 0:
+                # a dump with no device process (CPU backend: host Python
+                # frames only) is not device truth — fall through to host
+                attribution = None
+        if attribution is not None:
+            if args.merge and host_traces:
+                with open(host_traces[0], encoding="utf-8") as f:
+                    doc = json.load(f)
+                doc["traceEvents"] = merge_device_rows(
+                    doc.get("traceEvents", []), events
+                )
+                atomic_write_json(host_traces[0], doc, indent=None)
+                print(f"merged device rows into {host_traces[0]}", file=sys.stderr)
+    if attribution is None and host_traces:
+        attribution, source = _host_attribution(host_traces[0]), "host"
+        print(
+            "no device trace found — falling back to HOST spans "
+            "(async dispatch wall-clock, not device truth)",
+            file=sys.stderr,
+        )
+    if attribution is None:
+        print(f"no trace found under {profile_dir}", file=sys.stderr)
+        return 1
+
+    report = roofline_report(
+        snapshot,
+        attribution,
+        chip=args.chip,
+        measured_hbm_gbps=args.hbm_gbps,
+        source=source,
+    )
+    out_json = args.out_json or os.path.join(args.dir, "roofline.json")
+    out_md = args.out_md or os.path.join(args.dir, "roofline.md")
+    atomic_write_json(out_json, report)
+    atomic_write_text(out_md, render_markdown(report))
+    print(render_markdown(report))
+    print(f"wrote {out_json} and {out_md}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

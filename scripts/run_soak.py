@@ -165,6 +165,15 @@ def main(argv=None) -> int:
     for d in (ref_dir, chaos_dir):
         shutil.rmtree(d, ignore_errors=True)
 
+    # flight recorder for the SOAK ORCHESTRATOR itself: status.json in the
+    # out dir tracks which stage/kill the harness is at, so a soak frozen
+    # mid-kill is inspectable with `python -m stencil_tpu.status <out-dir>`
+    # (each chaos driver additionally heartbeats into its checkpoint dir
+    # through its own supervisor)
+    from stencil_tpu.telemetry.flight import FlightRecorder
+
+    flight = FlightRecorder(args.out_dir, label="soak")
+    flight.heartbeat(0, args.iters, stage="reference")
     print(f"== reference run: {args.iters} iters unkilled", file=sys.stderr)
     rc = launch(args, ref_dir, resume=False)
     if rc != 0:
@@ -188,6 +197,10 @@ def main(argv=None) -> int:
             f"== chaos kill {i + 1}/{args.kills}: {sig} at dispatch "
             f"{progress}+{offset} (plan {plan!r})",
             file=sys.stderr,
+        )
+        flight.heartbeat(
+            progress, args.iters, stage=f"chaos-kill-{i + 1}/{args.kills}",
+            signal=sig, at_dispatch=progress + offset,
         )
         rc = launch(args, chaos_dir, resume=i > 0, fault_plan=plan)
         launches += 1
@@ -213,6 +226,7 @@ def main(argv=None) -> int:
     # few launches only if something keeps failing — bound it)
     while True:
         print(f"== resume from step {progress}", file=sys.stderr)
+        flight.heartbeat(progress, args.iters, stage="resume", launches=launches)
         rc = launch(args, chaos_dir, resume=True)
         launches += 1
         if rc == 0:
@@ -243,7 +257,17 @@ def main(argv=None) -> int:
     path = os.path.join(args.out_dir, "soak_summary.json")
     atomic_write_json(path, summary)
     print(json.dumps(summary))
+    flight.heartbeat(
+        chaos["step"], args.iters,
+        phase="completed" if identical else "failed",
+        stage="verify", launches=launches, bitwise_identical=identical,
+    )
     if not identical:
+        flight.crash_report(
+            "soak_mismatch",
+            error="resumed fields differ from the unkilled run",
+            digests=summary["digests"],
+        )
         print("FAIL: resumed fields differ from the unkilled run", file=sys.stderr)
         return 1
     print(

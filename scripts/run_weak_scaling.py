@@ -170,9 +170,15 @@ def main(argv=None) -> int:
     meshes = [mesh_tuple(m) for m in args.meshes]
     os.makedirs(args.out_dir, exist_ok=True)
 
+    # flight recorder for the sweep: status.json in the out dir says which
+    # mesh is in flight (a per-mesh driver run at 512^3/chip is minutes of
+    # silence otherwise) — `python -m stencil_tpu.status <out-dir>`
+    from stencil_tpu.telemetry.flight import FlightRecorder
+
+    flight = FlightRecorder(args.out_dir, label="weak-scaling")
     have = None if args.dryrun else probe_device_count()
     results = []
-    for mesh in meshes:
+    for i, mesh in enumerate(meshes):
         need = mesh[0] * mesh[1] * mesh[2]
         if not args.dryrun:
             if have is not None and need > have:
@@ -185,10 +191,15 @@ def main(argv=None) -> int:
             args.out_dir, f"weak_{mesh[0]}x{mesh[1]}x{mesh[2]}.json"
         )
         print(f"== mesh {mesh} -> {out_path}", file=sys.stderr)
+        flight.heartbeat(
+            i, len(meshes), stage=f"mesh {mesh[0]}x{mesh[1]}x{mesh[2]}",
+            completed_meshes=len(results),
+        )
         doc = run_mesh(mesh, args, out_path)
         results.append(doc)
 
     if not results:
+        flight.heartbeat(0, len(meshes), phase="failed", stage="no mesh ran")
         print("no mesh ran (not enough devices?)", file=sys.stderr)
         return 1
 
@@ -231,6 +242,9 @@ def main(argv=None) -> int:
     path = os.path.join(args.out_dir, "weak_scaling_summary.json")
     atomic_write_json(path, summary)
     print(json.dumps(summary))
+    flight.heartbeat(
+        len(results), len(meshes), phase="completed", stage="summary"
+    )
     return 0
 
 

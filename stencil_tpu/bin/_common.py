@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import jax
@@ -75,6 +76,70 @@ def add_telemetry_flags(p: argparse.ArgumentParser) -> None:
         help="write a telemetry snapshot JSON to PATH at exit (enables "
         "telemetry; see docs/observability.md)",
     )
+
+
+def add_profile_flags(p: argparse.ArgumentParser) -> None:
+    """``--profile-dir``: cadence-gated ``jax.profiler`` captures around the
+    driver's dispatches (``STENCIL_PROFILE_EVERY`` sets the cadence; unset
+    = one capture).  At exit the device rows are merged into the Chrome
+    trace and a per-phase roofline report lands next to the captures —
+    docs/observability.md "Device-time attribution".  Degrades to a warning
+    on backends with no profiler."""
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        metavar="DIR",
+        help="capture jax.profiler traces here on the STENCIL_PROFILE_EVERY "
+        "cadence; device rows are merged into the Chrome trace and a "
+        "roofline report is written at exit (see docs/observability.md)",
+    )
+
+
+def profile_capture_for(args):
+    """A configured ``ProfileCapture`` from ``add_profile_flags``'s choice
+    (environment fills an unset flag), or None — profiling is opt-in."""
+    from stencil_tpu.telemetry.device import ProfileCapture
+
+    return ProfileCapture.from_env(dir=getattr(args, "profile_dir", None))
+
+
+def profile_finalize(args, capture, chrome_path: str = None) -> None:
+    """End-of-run device-truth artifacts: merge the newest capture's device
+    rows into the host Chrome trace at ``chrome_path`` (one Perfetto
+    timeline) and write the per-phase roofline report into the profile
+    dir.  Runs AFTER the final host-trace dump (``telemetry_end`` orders
+    this) so nothing re-dumps over the merged rows.  Best-effort — a
+    missing trace (no profiler backend) degrades to nothing, never an
+    error on the driver's exit path."""
+    if capture is None or capture.captures == 0:
+        return
+    import sys
+
+    from stencil_tpu.telemetry.device import merge_into_chrome_trace
+    from stencil_tpu.telemetry.roofline import capture_report, render_markdown
+    from stencil_tpu.utils.artifact import atomic_write_json, atomic_write_text
+
+    try:
+        if chrome_path is not None:
+            merge_into_chrome_trace(chrome_path, capture.dir)
+        from stencil_tpu.tune.key import chip_kind
+
+        report = capture_report(capture, chip=chip_kind())
+        if report is None:
+            print(
+                f"profile: no device rows under {capture.dir} (backend "
+                "without a device profiler?) — no roofline report; "
+                "scripts/perf_report.py can build a host-span fallback",
+                file=sys.stderr,
+            )
+            return
+        atomic_write_json(os.path.join(capture.dir, "roofline.json"), report)
+        atomic_write_text(
+            os.path.join(capture.dir, "roofline.md"), render_markdown(report)
+        )
+        print(f"profile: roofline report in {capture.dir}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — observability must not fail the run
+        print(f"profile finalize failed: {e!r}", file=sys.stderr)
 
 
 def add_tune_flags(p: argparse.ArgumentParser) -> None:
@@ -305,13 +370,19 @@ def telemetry_begin(args) -> None:
         atexit.register(args._telemetry_atexit)
 
 
-def telemetry_end(args) -> None:
+def telemetry_end(args, profile_capture=None) -> None:
     """Flush telemetry artifacts and write the ``--metrics-out`` snapshot on
-    ``main``'s clean exit path (the atexit hook covers crashed CLI runs)."""
+    ``main``'s clean exit path (the atexit hook covers crashed CLI runs).
+    ``profile_capture`` hands the driver's ``ProfileCapture`` in so the
+    device-row merge runs AFTER the final Chrome-trace dump — the other
+    order would re-dump host-only spans over the merged timeline."""
     from stencil_tpu import telemetry
 
+    arts = {}
     if telemetry.enabled():
-        telemetry.write_artifacts()
+        arts = telemetry.write_artifacts()
+    if profile_capture is not None:
+        profile_finalize(args, profile_capture, chrome_path=arts.get("trace"))
     path = getattr(args, "metrics_out", None)
     if path:
         _write_snapshot(path)

@@ -13,6 +13,7 @@ wall time around step+sync (jacobi3d.cu:265-341).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 import time
 
@@ -62,6 +63,7 @@ def main(argv=None) -> int:
         "achieved-overlap delta (reference --no-overlap A/B, jacobi3d.cu:265-337)",
     )
     _common.add_telemetry_flags(p)
+    _common.add_profile_flags(p)
     _common.add_tune_flags(p)
     _common.add_exchange_route_flag(p)
     _common.add_kernel_axis_flags(p)
@@ -185,6 +187,7 @@ def _run(args) -> int:
         print(f"wrote {model.dd.write_plan(args.prefix + 'plan')}", file=sys.stderr)
 
     iter_time = Statistics()
+    prof = _common.profile_capture_for(args)
     sup = _common.supervisor_for(
         args,
         model.dd,
@@ -197,14 +200,21 @@ def _run(args) -> int:
         },
     )
     mult = args.halo_multiplier
+    dispatch_index = [0]
 
     def timed_iter():
-        t0 = time.perf_counter()
-        model.step(mult)
-        model.block_until_ready()
-        # one macro (halo_multiplier raw iterations) per timed step; the
-        # CSV stays per-iteration so rows are comparable across multipliers
-        iter_time.insert((time.perf_counter() - t0) / mult)
+        # cadence device-profile capture around the dispatch (a captured
+        # iteration's timing sample carries profiler overhead — profiling
+        # is opt-in and the steady-state stats absorb one outlier)
+        idx = dispatch_index[0]
+        dispatch_index[0] += 1
+        with (prof.maybe(idx) if prof is not None else contextlib.nullcontext()):
+            t0 = time.perf_counter()
+            model.step(mult)
+            model.block_until_ready()
+            # one macro (halo_multiplier raw iterations) per timed step; the
+            # CSV stays per-iteration so rows are comparable across multipliers
+            iter_time.insert((time.perf_counter() - t0) / mult)
 
     from stencil_tpu.telemetry import trace
 
@@ -257,7 +267,7 @@ def _run(args) -> int:
             f"jacobi3d,{_common.method_str(args)},{ranks},{dev_count},"
             f"{x},{y},{z},{iter_time.min()},{iter_time.trimean()}"
         )
-    _common.telemetry_end(args)
+    _common.telemetry_end(args, profile_capture=prof)
     return rc
 
 

@@ -13,6 +13,7 @@ from stencil_tpu.lint.rules import (  # noqa: F401
     env_reads,
     jax_free,
     layout_traps,
+    span_name,
     telemetry_names,
     tier1_budget,
 )

@@ -26,6 +26,13 @@ preemption notice, a SIGKILL, a FATAL dispatch error, a wedged device.
   restart).  The ladder keeps handling VMEM_OOM/COMPILE_REJECT and retry
   keeps handling TRANSIENT before anything reaches here; DIVERGENCE is
   never restarted (the same numerics diverge again).
+* **Flight recorder** — a rank-0 ``status.json`` heartbeat in the
+  checkpoint dir per chunk (step, steady-state rate, checkpoint age,
+  watchdog state, restart count, last classified error) and a
+  ``crash_report.json`` (classified cause + the last-N telemetry events
+  from the in-memory ring) on any propagating FATAL/STALL/PREEMPTED
+  exit; ``python -m stencil_tpu.status <dir>`` renders both
+  (telemetry/flight.py, docs/observability.md "Flight recorder").
 
 Knobs (validated reads — utils/config.py): ``STENCIL_CHECKPOINT_DIR``,
 ``STENCIL_CHECKPOINT_EVERY`` (steps), ``STENCIL_CHECKPOINT_EVERY_S``
@@ -48,6 +55,7 @@ from stencil_tpu.io.checkpoint import restore_latest, save_to_ring
 from stencil_tpu.resilience.retry import buffers_live
 from stencil_tpu.resilience.taxonomy import FailureClass, classify
 from stencil_tpu.telemetry import names as tm
+from stencil_tpu.telemetry.flight import FlightRecorder
 from stencil_tpu.utils.logging import log_info, log_warn
 
 #: sysexits EX_TEMPFAIL — "try again later"; schedulers re-queue this code
@@ -129,6 +137,7 @@ class RunSupervisor:
         config: SupervisorConfig,
         label: str = "run",
         run_state: Optional[Callable[[], dict]] = None,
+        flight: Optional[FlightRecorder] = None,
     ):
         self.dd = dd
         self.config = config
@@ -137,6 +146,14 @@ class RunSupervisor:
         self.last_run_state: dict = {}
         #: the ring path the last resume() restored from (None = cold start)
         self.resumed_path: Optional[str] = None
+        #: the flight recorder: per-chunk heartbeat ``status.json`` +
+        #: ``crash_report.json`` on any propagating exit, both in the
+        #: checkpoint dir — ``python -m stencil_tpu.status <dir>`` renders
+        #: them (docs/observability.md "Flight recorder")
+        self.flight = flight if flight is not None else FlightRecorder(
+            config.dir, label=label
+        )
+        self._last_error: Optional[str] = None
         self._preempted = False
         self._preempt_why = ""
 
@@ -186,6 +203,35 @@ class RunSupervisor:
             self.checkpoint(step, reason=reason)
         except Exception as e:  # the exit path must stay resumable
             log_warn(f"{self.label}: final checkpoint failed ({e}); the last ring checkpoint stands")
+
+    # --- flight recorder ------------------------------------------------------
+
+    def _watchdog_state(self) -> str:
+        wd = getattr(self.dd, "_get_watchdog", lambda: None)()
+        if wd is None:
+            return "off"
+        return (
+            f"armed({wd.deadline_s:g}s{', abort' if wd.abort else ''})"
+        )
+
+    def _heartbeat(
+        self, step: int, total_steps: int, restarts: int, last_ck: float,
+        phase: str = "running",
+    ) -> None:
+        """One status.json rewrite: progress, rate, checkpoint age,
+        watchdog arming, restart count, last classified error, and the
+        caller's run_state (which carries the decisions in effect —
+        ladder rung / kernel axes when the model exposes them)."""
+        self.flight.heartbeat(
+            step,
+            total_steps,
+            phase=phase,
+            checkpoint_age_s=round(time.monotonic() - last_ck, 3),
+            restarts=restarts,
+            watchdog=self._watchdog_state(),
+            last_error=self._last_error,
+            run_state=self._run_state() if self._run_state is not None else None,
+        )
 
     # --- preemption -----------------------------------------------------------
 
@@ -252,6 +298,9 @@ class RunSupervisor:
             # listdir — the resume() above already paid the validation
             # pass when entries existed)
             self.checkpoint(step, reason="initial")
+        # first heartbeat before any chunk: a kill during the very first
+        # dispatch must still leave a readable status.json
+        self._heartbeat(step, total_steps, restarts, last_ck)
         try:
             while step < total_steps:
                 n = min(chunk, total_steps - step)
@@ -265,6 +314,7 @@ class RunSupervisor:
                     advance(n)
                 except (Exception, KeyboardInterrupt) as e:
                     cls = classify(e)
+                    self._last_error = f"{cls.value}: {str(e)[:300]}"
                     if cls is FailureClass.PREEMPTED:
                         # the chunk died partway: the domain is an UNKNOWN
                         # number of iterations past `step`, so no final
@@ -280,7 +330,10 @@ class RunSupervisor:
                     ):
                         restored = self.resume()
                         if self.resumed_path is None:
-                            raise  # nothing valid to restart from
+                            # nothing valid to restart from — the exit is
+                            # final, so dump the post-mortem first
+                            self.flight.crash_report(cls.value, error=str(e))
+                            raise
                         restarts += 1
                         telemetry.inc(tm.SUPERVISOR_RESTARTS)
                         telemetry.emit_event(
@@ -299,15 +352,19 @@ class RunSupervisor:
                         )
                         step = restored
                         last_ck = time.monotonic()
+                        self._heartbeat(step, total_steps, restarts, last_ck)
                         continue
                     else:
                         # out of budget, no checkpoint to restart from, or a
-                        # class the in-process machinery owns — propagate
+                        # class the in-process machinery owns — propagate,
+                        # leaving the crash report as the post-mortem
+                        self.flight.crash_report(cls.value, error=str(e))
                         raise
                 else:
                     step += n
                     if on_chunk is not None:
                         on_chunk(step, n)
+                    self._heartbeat(step, total_steps, restarts, last_ck)
                 if self._preempted:
                     if mid_chunk:
                         log_warn(
@@ -320,6 +377,15 @@ class RunSupervisor:
                     log_warn(
                         f"{self.label}: preempted ({self._preempt_why}) at "
                         f"step {step}; exiting resumable (code {EXIT_RESUMABLE})"
+                    )
+                    self._heartbeat(
+                        step, total_steps, restarts, last_ck, phase="preempted"
+                    )
+                    self.flight.crash_report(
+                        "preempted",
+                        error=self._preempt_why,
+                        mid_chunk=mid_chunk,
+                        resumable_step=step,
                     )
                     return RunOutcome(
                         completed=False,
@@ -347,4 +413,7 @@ class RunSupervisor:
         # (manifest digests make that a metadata read), and the natural
         # resume-past-the-end no-op marker
         self.checkpoint(step, reason="final")
+        self._heartbeat(
+            step, total_steps, restarts, time.monotonic(), phase="completed"
+        )
         return RunOutcome(completed=True, step=step, restarts=restarts)

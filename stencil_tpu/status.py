@@ -1,0 +1,122 @@
+"""``python -m stencil_tpu.status <dir>`` — render a run's flight-recorder
+state, live or post-mortem.
+
+Reads the ``status.json`` heartbeat and ``crash_report.json`` (both
+written by ``telemetry/flight.py`` under the supervised run's directory —
+usually the checkpoint dir) and prints a human summary: phase, progress,
+steady-state rate, heartbeat age (a stale heartbeat on a ``running`` phase
+means the process died without a word), checkpoint age, restarts, last
+error, and the crash report's classified cause plus its last-events tail.
+
+``--json`` prints the merged raw documents instead (for scripts).
+jax-free and import-light: inspecting a wedged run must not wait on a
+backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from stencil_tpu.telemetry.flight import read_crash_report, read_status
+
+
+def _age(ts) -> str:
+    try:
+        dt = max(time.time() - float(ts), 0.0)
+    except (TypeError, ValueError):
+        return "?"
+    if dt < 120:
+        return f"{dt:.1f}s"
+    if dt < 7200:
+        return f"{dt / 60:.1f}m"
+    return f"{dt / 3600:.1f}h"
+
+
+def render(status, crash, stale_after: float = 300.0) -> str:
+    """The human view of one run directory's flight state."""
+    lines = []
+    if status is None and crash is None:
+        return "no flight-recorder state found (no status.json / crash_report.json)"
+    if status is not None:
+        phase = status.get("phase", "?")
+        ts = status.get("ts")
+        stale = (
+            phase == "running"
+            and isinstance(ts, (int, float))
+            and time.time() - ts > stale_after
+        )
+        total = status.get("total_steps")
+        prog = f"{status.get('step')}/{total}" if total else str(status.get("step"))
+        rate = status.get("rate_steps_per_s")
+        lines.append(
+            f"run '{status.get('label')}' [{phase}]"
+            + (" — heartbeat STALE (process likely dead)" if stale else "")
+        )
+        lines.append(
+            f"  step {prog}"
+            + (f" @ {rate:.3g} steps/s" if isinstance(rate, (int, float)) else "")
+            + f", heartbeat {_age(ts)} ago (pid {status.get('pid')})"
+        )
+        extras = []
+        for key, label in (
+            ("checkpoint_age_s", "checkpoint age"),
+            ("restarts", "restarts"),
+            ("ladder_rung", "ladder rung"),
+            ("watchdog", "watchdog"),
+        ):
+            if status.get(key) is not None:
+                val = status[key]
+                if key == "checkpoint_age_s":
+                    val = f"{float(val):.1f}s"
+                extras.append(f"{label} {val}")
+        if extras:
+            lines.append("  " + ", ".join(extras))
+        if status.get("last_error"):
+            lines.append(f"  last error: {status['last_error']}")
+    if crash is not None:
+        lines.append(
+            f"crash report [{crash.get('cause')}] at {_age(crash.get('ts'))} ago"
+        )
+        if crash.get("error"):
+            lines.append(f"  error: {crash['error']}")
+        events = crash.get("events") or []
+        if events:
+            lines.append(f"  last {len(events)} events:")
+            for e in events[-10:]:
+                fields = {
+                    k: v for k, v in e.items() if k not in ("ts", "event")
+                }
+                lines.append(f"    {e.get('event')}: {fields}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "stencil_tpu.status",
+        description="render a supervised run's flight-recorder state "
+        "(see docs/observability.md 'Flight recorder')",
+    )
+    p.add_argument("dir", help="run directory holding status.json / crash_report.json")
+    p.add_argument("--json", action="store_true", help="print the raw documents")
+    p.add_argument(
+        "--stale-after",
+        type=float,
+        default=300.0,
+        metavar="S",
+        help="seconds after which a 'running' heartbeat is reported stale",
+    )
+    args = p.parse_args(argv)
+    status = read_status(args.dir)
+    crash = read_crash_report(args.dir)
+    if args.json:
+        print(json.dumps({"status": status, "crash_report": crash}, indent=2))
+    else:
+        print(render(status, crash, stale_after=args.stale_after))
+    return 0 if (status is not None or crash is not None) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,11 +39,12 @@ Design rules (enforced here, asserted by tests):
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
 import time
-from typing import Optional
+from typing import List, Optional
 
 from stencil_tpu.telemetry import names  # noqa: F401  (re-export)
 from stencil_tpu.telemetry.events import EventSink
@@ -57,6 +58,19 @@ from stencil_tpu.telemetry.spans import (  # noqa: F401  (annotate/trace re-expo
 from stencil_tpu.utils.logging import _rank
 
 
+#: events kept in the in-memory flight ring (the crash-report tail)
+RING_SIZE = 256
+
+#: counters sampled onto Chrome counter tracks at every span record — the
+#: cumulative series whose slope IS the throughput Perfetto shows next to
+#: the spans (exchange/packed bytes, MXU flops)
+_TRACK_COUNTERS = (
+    names.EXCHANGE_BYTES,
+    names.EXCHANGE_PACKED_BYTES,
+    names.KERNEL_MXU_FLOPS,
+)
+
+
 class _Telemetry:
     """Process-local singleton state (module functions below delegate)."""
 
@@ -67,6 +81,11 @@ class _Telemetry:
         self.enabled = False
         self.out_dir: Optional[str] = None
         self._configured = False
+        #: bounded flight ring of the last events — ALWAYS live (one deque
+        #: append; the caller already built the fields dict), because the
+        #: runs whose last events matter most are the ones that die with
+        #: telemetry off.  Dumped by the flight recorder's crash report.
+        self.ring = collections.deque(maxlen=RING_SIZE)
 
     def configure_from_env(self) -> None:
         from stencil_tpu.utils.config import env_bool, env_str
@@ -135,10 +154,12 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Clear all recorded metrics and spans (counters restart at 0)."""
+    """Clear all recorded metrics, spans, and the event ring (counters
+    restart at 0)."""
     t = _cfg()
     t.registry.reset()
     t.spans.clear()
+    t.ring.clear()
 
 
 # --- metrics -----------------------------------------------------------------
@@ -190,6 +211,7 @@ def span(name: str, histogram: Optional[str] = None, **args):
         dur = time.perf_counter() - t0
         t.spans.pop()
         t.spans.record(name, t0, dur, parent=parent, **args)
+        _sample_track_counters(t, t0 + dur)
         if histogram is not None:
             t.registry.histogram(histogram).observe(dur)
 
@@ -203,8 +225,18 @@ def record_span(
     if not t.enabled:
         return
     t.spans.record(name, t0, dur, **args)
+    _sample_track_counters(t, t0 + dur)
     if histogram is not None:
         t.registry.histogram(histogram).observe(dur)
+
+
+def _sample_track_counters(t: _Telemetry, at: float) -> None:
+    """Sample the cumulative track counters onto the Chrome counter tracks
+    at span-record time (``at`` is a ``perf_counter`` value).  Three dict
+    hits per recorded span; identical consecutive values are dropped by the
+    recorder, so quiet series cost one event total."""
+    for name in _TRACK_COUNTERS:
+        t.spans.sample_counter(name, t.registry.counter(name).value, at)
 
 
 def dump_chrome_trace(path: Optional[str] = None) -> Optional[str]:
@@ -230,11 +262,28 @@ def dump_chrome_trace(path: Optional[str] = None) -> Optional[str]:
 
 
 def emit_event(name: str, **fields) -> None:
-    """Append one structured JSONL event.  No-op unless enabled AND a sink
-    directory is configured — guarded before any formatting happens."""
+    """Append one structured JSONL event.  The JSONL sink runs only while
+    enabled AND a sink directory is configured — guarded before any
+    formatting happens.  The in-memory flight ring records ALWAYS (one
+    deque append of the dict the caller already built): like the counters,
+    the last events before a crash must survive telemetry being off —
+    the flight recorder dumps them as the crash report
+    (docs/observability.md "Flight recorder")."""
     t = _cfg()
+    t.ring.append({"ts": time.time(), "event": name, **fields})
     if t.enabled and t.sink is not None:
         t.sink.emit(name, fields)
+
+
+def recent_events(n: Optional[int] = None) -> List[dict]:
+    """The last ``n`` (default: all retained) events from the bounded
+    in-memory flight ring, oldest first — the post-mortem tail a crash
+    report captures even when no JSONL sink was configured."""
+    ring = _cfg().ring
+    out = list(ring)
+    if n is not None:
+        out = out[-n:]
+    return out
 
 
 def event_log_path() -> Optional[str]:
@@ -242,7 +291,28 @@ def event_log_path() -> Optional[str]:
     return t.sink.path() if t.sink is not None else None
 
 
+def dump_metrics(path: Optional[str] = None) -> Optional[str]:
+    """Write the metrics snapshot as JSON; returns the path (None when
+    nowhere to put it).  Default home: ``metrics_<rank>.json`` next to the
+    trace/events, which makes a telemetry dir self-contained for
+    ``scripts/perf_report.py`` (the roofline join needs the analytic
+    counters AND the trace from the same run)."""
+    t = _cfg()
+    if path is None:
+        if t.out_dir is None:
+            return None
+        path = os.path.join(t.out_dir, f"metrics_{_rank()}.json")
+    from stencil_tpu.utils.artifact import atomic_write_json
+
+    return atomic_write_json(path, snapshot())
+
+
 def write_artifacts() -> dict:
-    """Flush end-of-run artifacts (the Chrome trace; events stream live).
-    Returns ``{"trace": path_or_None, "events": path_or_None}``."""
-    return {"trace": dump_chrome_trace(), "events": event_log_path()}
+    """Flush end-of-run artifacts (the Chrome trace and metrics snapshot;
+    events stream live).  Returns ``{"trace": ..., "events": ...,
+    "metrics": ...}`` (path or None each)."""
+    return {
+        "trace": dump_chrome_trace(),
+        "events": event_log_path(),
+        "metrics": dump_metrics(),
+    }

@@ -1,0 +1,173 @@
+"""Run flight recorder: a rank-0 heartbeat status file plus a crash report
+built from the bounded in-memory event ring.
+
+A supervised long run (resilience/supervisor.py) is opaque from outside the
+process: the checkpoint ring says where it COULD resume, not whether it is
+alive, how fast it is going, or what it last complained about.  The flight
+recorder closes that gap with two artifacts in the run directory, both
+written through ``utils/artifact`` (atomic; a kill mid-write leaves the
+previous readable state):
+
+* ``status.json`` — the heartbeat, rewritten per chunk: step/total, the
+  steady-state rate over a sliding window, checkpoint age, watchdog state,
+  ladder rung, restart count, last classified error, and a ``phase``
+  (``running`` / ``completed`` / ``preempted`` / a failure class).  A
+  reader that finds a stale ``ts`` knows the process died without a word —
+  that silence is itself the signal.
+* ``crash_report.json`` — dumped on any FATAL/STALL/PREEMPTED (or
+  otherwise propagating) exit: the classified cause, the error text, the
+  final status, and the last-N telemetry events from the always-live ring
+  (``telemetry.recent_events`` — captured even when no JSONL sink was
+  configured, exactly like the counters).
+
+``python -m stencil_tpu.status <dir>`` renders either, live or
+post-mortem.  Only rank 0 writes (every rank sees the same supervisor
+state); jax-free, like everything in this package — the crash path runs
+while jax may be mid-failure.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from typing import Optional
+
+from stencil_tpu.utils.logging import _rank, log_warn
+
+
+def _write_json(path: str, doc: dict) -> str:
+    """Atomic JSON write with ``default=str``: ring events and caller
+    ``state`` may hold non-JSON values (numpy scalars, paths) — the same
+    tolerance the JSONL sink applies — and these writes run on exit paths
+    where a serialization error would mask the real failure."""
+    from stencil_tpu.utils.artifact import atomic_write
+
+    with atomic_write(path) as f:
+        json.dump(doc, f, indent=2, sort_keys=True, default=str)
+        f.write("\n")
+    return path
+
+STATUS_FILE = "status.json"
+CRASH_FILE = "crash_report.json"
+
+#: heartbeats kept for the sliding steady-state rate window
+_RATE_WINDOW = 32
+
+#: events included in a crash report (the ring retains more; a report
+#: wants the readable tail, not the whole flight)
+CRASH_EVENT_TAIL = 64
+
+
+class FlightRecorder:
+    """Heartbeat + crash-report writer for one supervised run."""
+
+    def __init__(self, dir: str, label: str = "run"):
+        self.dir = str(dir)
+        self.label = label
+        self._window = collections.deque(maxlen=_RATE_WINDOW)
+        self._last_status: dict = {}
+
+    @property
+    def status_path(self) -> str:
+        return os.path.join(self.dir, STATUS_FILE)
+
+    @property
+    def crash_path(self) -> str:
+        return os.path.join(self.dir, CRASH_FILE)
+
+    def _rate(self, step: int) -> Optional[float]:
+        """Steady-state steps/s over the heartbeat window (None until two
+        beats have landed).  A step that moved BACKWARD (the supervisor
+        restored an earlier checkpoint) resets the window — pre-restart
+        beats would otherwise report None/understated rates for the whole
+        post-restart window, exactly when an operator is looking."""
+        now = time.monotonic()
+        if self._window and step < self._window[-1][1]:
+            self._window.clear()
+        self._window.append((now, step))
+        (t0, s0), (t1, s1) = self._window[0], self._window[-1]
+        if t1 <= t0:
+            return None
+        return (s1 - s0) / (t1 - t0)
+
+    def heartbeat(
+        self,
+        step: int,
+        total_steps: Optional[int] = None,
+        phase: str = "running",
+        **state,
+    ) -> Optional[str]:
+        """Atomically rewrite ``status.json`` (rank 0 only; other ranks
+        no-op).  ``state`` carries the caller's extras — checkpoint age,
+        watchdog state, ladder rung, restarts, last error.  Never raises:
+        a full disk must not kill the run it was observing."""
+        if _rank() != 0:
+            return None
+        doc = {
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "label": self.label,
+            "phase": phase,
+            "step": int(step),
+            "total_steps": total_steps,
+            "rate_steps_per_s": self._rate(int(step)),
+        }
+        doc.update(state)
+        self._last_status = doc
+        try:
+            return _write_json(self.status_path, doc)
+        except Exception as e:  # noqa: BLE001 — the never-raise contract:
+            # a heartbeat must not kill the run it observes
+            log_warn(f"{self.label}: heartbeat write failed ({e}); continuing")
+            return None
+
+    def crash_report(
+        self, cause: str, error: Optional[str] = None, **state
+    ) -> Optional[str]:
+        """Dump ``crash_report.json``: the classified cause, error text,
+        final status, metric counters, and the last-N telemetry events
+        from the in-memory ring.  Rank 0 only; never raises — this runs on
+        exit paths where a second failure would mask the first."""
+        if _rank() != 0:
+            return None
+        from stencil_tpu import telemetry
+
+        doc = {
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "label": self.label,
+            "cause": cause,
+            "error": (error or "")[:2000] or None,
+            "status": dict(self._last_status) or None,
+            "counters": telemetry.snapshot().get("counters", {}),
+            "events": telemetry.recent_events(CRASH_EVENT_TAIL),
+        }
+        doc.update(state)
+        try:
+            return _write_json(self.crash_path, doc)
+        except Exception as e:  # noqa: BLE001 — this runs inside exception
+            # handlers; a second failure here would MASK the classified one
+            log_warn(f"{self.label}: crash report write failed ({e})")
+            return None
+
+
+def read_status(dir: str) -> Optional[dict]:
+    """The heartbeat document under ``dir`` (None when absent/corrupt —
+    atomic writes make corrupt mean 'never written')."""
+    return _read_json(os.path.join(dir, STATUS_FILE))
+
+
+def read_crash_report(dir: str) -> Optional[dict]:
+    return _read_json(os.path.join(dir, CRASH_FILE))
+
+
+def _read_json(path: str) -> Optional[dict]:
+    import json
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

@@ -75,6 +75,13 @@ class Histogram:
             "stddev": _json_safe(s.stddev()),
             "med": _json_safe(s.med()),
             "trimean": _json_safe(s.trimean()),
+            # the tail view the trimean discards: cross-round diffs of a
+            # timing series need p95/p99 to see a regression that only
+            # shows up as jitter (p50 rides along as the self-check twin
+            # of med)
+            "p50": _json_safe(s.quantile(0.50)),
+            "p95": _json_safe(s.quantile(0.95)),
+            "p99": _json_safe(s.quantile(0.99)),
         }
 
 

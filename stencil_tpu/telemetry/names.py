@@ -84,6 +84,11 @@ SUPERVISOR_RESTARTS = "supervisor.restarts"
 #: watchdog deadline trips (resilience/watchdog.py): dispatches that ran
 #: past STENCIL_WATCHDOG_S without completing
 WATCHDOG_STALLS = "watchdog.stalls"
+#: device-profile captures taken by the cadence profiler
+#: (telemetry/device.py ``ProfileCapture`` — STENCIL_PROFILE_EVERY /
+#: ``--profile-dir``); 0 when profiling is off or the backend has no
+#: profiler (the capture degrades to a warn, never a crash)
+PROFILE_CAPTURES = "profile.captures"
 
 ALL_COUNTERS = frozenset({
     EXCHANGE_COUNT,
@@ -111,6 +116,7 @@ ALL_COUNTERS = frozenset({
     CHECKPOINT_INVALID,
     SUPERVISOR_RESTARTS,
     WATCHDOG_STALLS,
+    PROFILE_CAPTURES,
 })
 
 # --- gauges (last-value) -----------------------------------------------------
@@ -226,6 +232,9 @@ EVENT_SUPERVISOR_RESTART = "supervisor.restart"
 #: the watchdog saw a dispatch exceed its deadline (fields: phase,
 #: deadline_s, abort)
 EVENT_WATCHDOG_STALL = "watchdog.stall"
+#: a cadence device-profile capture finished (fields: dir, index,
+#: seconds — telemetry/device.py)
+EVENT_PROFILE_CAPTURE = "profile.capture"
 
 ALL_EVENTS = frozenset({
     EVENT_COMPILE,
@@ -246,6 +255,7 @@ ALL_EVENTS = frozenset({
     EVENT_CHECKPOINT_FALLBACK,
     EVENT_SUPERVISOR_RESTART,
     EVENT_WATCHDOG_STALL,
+    EVENT_PROFILE_CAPTURE,
 })
 
 #: every registered name, any kind — what the lint checks literals against

@@ -1,0 +1,223 @@
+"""Roofline reports: join measured device time per phase with the analytic
+counters the tree already records.
+
+The PERF_NOTES break-even models (VPU wall, split-step overlap, zpack) all
+end in the same table a human currently assembles by hand: achieved GB/s /
+GFLOP/s per phase vs the chip's peak.  This module builds that table from
+two inputs this repo already produces —
+
+* a **metrics snapshot** (``telemetry.snapshot()`` /
+  ``metrics_<rank>.json``): the analytic counters ``domain.exchange.bytes``,
+  ``exchange.packed.bytes``, ``kernel.mxu.flops``;
+* a **device-time attribution** (``telemetry/device.py``): measured device
+  microseconds per phase from a ``jax.profiler`` capture, or — when no
+  profiler backend exists — host span durations as a degraded stand-in
+  (tagged ``"source": "host"``; host wall-clock of an async dispatch is an
+  upper bound on nothing, so the tag matters).
+
+The portable-stencil framework survey (arxiv 2309.04671) ranks kernels by
+achieved-vs-roofline; ``scripts/perf_report.py`` renders this module's
+JSON as that table, and ``bench.py`` embeds it when profiling is on.
+
+jax-free: reports are built offline, often from a dead run's artifacts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from stencil_tpu.telemetry import names
+
+#: nominal per-chip peaks keyed by ``device_kind`` prefix (the same labels
+#: ``tune.key.chip_kind`` persists).  Numbers follow PERF_NOTES ("VPU
+#: wall": v5e-class ≈ 197 Tf32-FLOP/s MXU, 819 GB/s HBM); unknown chips
+#: (and CPU dryruns) carry None peaks — the report then shows achieved
+#: rates with a null roofline fraction instead of inventing a ceiling.
+PEAKS: Dict[str, dict] = {
+    "TPU v5e": {"hbm_gbps": 819.0, "mxu_gflops_f32": 197_000.0,
+                "mxu_gflops_bf16": 394_000.0},
+    "TPU v5p": {"hbm_gbps": 2765.0, "mxu_gflops_f32": 229_500.0,
+                "mxu_gflops_bf16": 459_000.0},
+    "TPU v4": {"hbm_gbps": 1228.0, "mxu_gflops_f32": 137_500.0,
+               "mxu_gflops_bf16": 275_000.0},
+}
+
+#: phase -> the analytic counter carrying its traffic/work (the join key)
+PHASE_BYTES_COUNTERS = {
+    "exchange": names.EXCHANGE_BYTES,
+    "pack": names.EXCHANGE_PACKED_BYTES,
+}
+PHASE_FLOPS_COUNTERS = {
+    "mxu": names.KERNEL_MXU_FLOPS,
+}
+
+
+def peaks_for(chip: Optional[str],
+              measured_hbm_gbps: Optional[float] = None) -> dict:
+    """The peak table for ``chip`` (prefix match over ``PEAKS``), with the
+    MEASURED copy bandwidth substituted for the nominal HBM number when
+    available — a time-shared/throttled chip's honest ceiling is what it
+    measured, not the datasheet (the ``chip_copy_gbps`` rule bench.py
+    already applies to its headline)."""
+    out = {"chip": chip, "hbm_gbps": None, "mxu_gflops_f32": None,
+           "mxu_gflops_bf16": None, "hbm_source": None}
+    if chip:
+        for prefix, vals in PEAKS.items():
+            if chip.startswith(prefix):
+                out.update(vals)
+                out["hbm_source"] = "nominal"
+                break
+    if measured_hbm_gbps:
+        out["hbm_gbps"] = float(measured_hbm_gbps)
+        out["hbm_source"] = "measured"
+    return out
+
+
+def _counters(snapshot: Optional[dict]) -> dict:
+    return (snapshot or {}).get("counters", {}) or {}
+
+
+def roofline_report(
+    snapshot: Optional[dict],
+    attribution: Optional[dict],
+    chip: Optional[str] = None,
+    measured_hbm_gbps: Optional[float] = None,
+    source: str = "device",
+    counters_scope: str = "run",
+) -> dict:
+    """The per-phase roofline join.
+
+    ``attribution`` is ``{phase: {"device_us": ..., "events": ...}}``
+    (``telemetry.device.attribute_device_time``; a host-span fallback uses
+    the same shape with ``source="host"``).  Phases carrying an analytic
+    bytes counter report achieved GB/s and their fraction of the HBM
+    roofline; the ``mxu`` phase reports GFLOP/s vs the MXU peak; scope
+    phases with no counter (interior/exterior) report time and their share
+    of total device time — the overlap-efficiency inputs.
+
+    ``counters_scope`` records what window the counters cover, because the
+    join is only honest when numerator and denominator cover the SAME
+    window: ``"capture"`` = the counter deltas of the profiled window
+    (``ProfileCapture.counters_snapshot`` — what the drivers pass);
+    ``"run"`` = whole-run cumulative counters (offline ``perf_report``
+    over ``metrics_*.json``), where achieved rates overstate by
+    (run work / captured work) unless the run captured its whole measured
+    loop.
+    """
+    counters = _counters(snapshot)
+    attribution = attribution or {}
+    peaks = peaks_for(chip, measured_hbm_gbps)
+    total_us = attribution.get("_total", {}).get("device_us", 0.0)
+    phases = {}
+    for phase, row in attribution.items():
+        if phase.startswith("_"):
+            continue
+        us = float(row.get("device_us", 0.0))
+        s = us / 1e6
+        entry = {
+            "device_ms": round(us / 1e3, 6),
+            "events": int(row.get("events", 0)),
+            "share_of_device": round(us / total_us, 4) if total_us else None,
+            "bytes": None,
+            "gbps": None,
+            "flops": None,
+            "gflops": None,
+            "frac_of_roofline": None,
+        }
+        bc = PHASE_BYTES_COUNTERS.get(phase)
+        if bc is not None:
+            b = counters.get(bc)
+            if b:
+                entry["bytes"] = int(b)
+                if s > 0:
+                    entry["gbps"] = round(b / s / 1e9, 3)
+                    if peaks["hbm_gbps"]:
+                        entry["frac_of_roofline"] = round(
+                            entry["gbps"] / peaks["hbm_gbps"], 4
+                        )
+        fc = PHASE_FLOPS_COUNTERS.get(phase)
+        if fc is not None:
+            fl = counters.get(fc)
+            if fl:
+                entry["flops"] = int(fl)
+                if s > 0:
+                    entry["gflops"] = round(fl / s / 1e9, 3)
+                    if peaks["mxu_gflops_f32"]:
+                        entry["frac_of_roofline"] = round(
+                            entry["gflops"] / peaks["mxu_gflops_f32"], 4
+                        )
+        phases[phase] = entry
+    return {
+        "source": source,
+        "counters_scope": counters_scope,
+        "peaks": peaks,
+        "total_device_ms": round(total_us / 1e3, 6) if total_us else None,
+        "unattributed_device_ms": round(
+            attribution.get("_unattributed", {}).get("device_us", 0.0) / 1e3,
+            6,
+        ),
+        "phases": phases,
+    }
+
+
+def capture_report(
+    capture,
+    chip: Optional[str] = None,
+    measured_hbm_gbps: Optional[float] = None,
+) -> Optional[dict]:
+    """``roofline_report`` for a ``ProfileCapture``'s newest window: the
+    dump's attribution joined with the capture-window counter deltas
+    (whole-run snapshot fallback, tagged in ``counters_scope``).  Returns
+    None when the capture produced no device rows (backend without a
+    device profiler) — THE shared finalize for ``bench.py`` (embeds the
+    report) and ``bin/_common.profile_finalize`` (writes it)."""
+    attribution = capture.attribution()
+    if attribution is None or attribution["_total"]["events"] == 0:
+        return None
+    deltas = capture.counters_snapshot()
+    from stencil_tpu import telemetry
+
+    return roofline_report(
+        deltas if deltas is not None else telemetry.snapshot(),
+        attribution,
+        chip=chip,
+        measured_hbm_gbps=measured_hbm_gbps,
+        counters_scope="capture" if deltas is not None else "run",
+    )
+
+
+def render_markdown(report: dict) -> str:
+    """The report as the PERF_NOTES-style markdown table."""
+    peaks = report.get("peaks", {})
+    lines = [
+        "# Per-phase roofline",
+        "",
+        f"- chip: `{peaks.get('chip')}`  "
+        f"(HBM peak {peaks.get('hbm_gbps')} GB/s "
+        f"[{peaks.get('hbm_source') or 'unknown'}], "
+        f"MXU f32 peak {peaks.get('mxu_gflops_f32')} GFLOP/s)",
+        f"- timing source: **{report.get('source')}** "
+        + ("(device truth)" if report.get("source") == "device"
+           else "(host spans — async dispatch upper bound only)"),
+        f"- counters scope: **{report.get('counters_scope')}** "
+        + ("(capture-window deltas — rates are honest)"
+           if report.get("counters_scope") == "capture"
+           else "(whole-run cumulative — rates overstate unless the "
+           "capture covered the whole measured loop)"),
+        f"- total device time: {report.get('total_device_ms')} ms "
+        f"(unattributed {report.get('unattributed_device_ms')} ms)",
+        "",
+        "| phase | device ms | events | share | GB/s | GFLOP/s | % of roofline |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for phase in sorted(report.get("phases", {})):
+        e = report["phases"][phase]
+        frac = e.get("frac_of_roofline")
+        lines.append(
+            f"| `{phase}` | {e['device_ms']} | {e['events']} | "
+            f"{e.get('share_of_device')} | {e.get('gbps') or ''} | "
+            f"{e.get('gflops') or ''} | "
+            f"{f'{100 * frac:.1f}%' if frac is not None else ''} |"
+        )
+    lines.append("")
+    return "\n".join(lines)

@@ -20,10 +20,15 @@ pull in — let alone initialize — a jax backend.
 from __future__ import annotations
 
 import contextlib
+import os
 import sys
 import threading
 import time
 from typing import List, Optional
+
+#: warn once per process when the profiler backend is absent — a CPU dryrun
+#: container must run a profiled command line unchanged, just without traces
+_trace_unavailable_warned = False
 
 
 def annotate(name: str):
@@ -36,14 +41,45 @@ def annotate(name: str):
 @contextlib.contextmanager
 def trace(log_dir: Optional[str]):
     """Capture a ``jax.profiler`` trace into ``log_dir`` (no-op when None).
-    View with TensorBoard's profile plugin / xprof."""
+    View with TensorBoard's profile plugin / xprof.
+
+    Degrades gracefully: the directory is created up front (a capture that
+    dies mid-run must still leave the dir its tooling expects), and a
+    backend with no profiler support (CPU dryrun containers, tunneled dev
+    backends) WARNS once and runs the body unprofiled — a profiling knob
+    must never crash the run it was meant to observe."""
     if not log_dir:
         yield
         return
+    os.makedirs(log_dir, exist_ok=True)
     import jax
 
-    with jax.profiler.trace(log_dir):
+    global _trace_unavailable_warned
+    ctx = None
+    try:
+        ctx = jax.profiler.trace(log_dir)
+        ctx.__enter__()
+    except Exception as e:  # noqa: BLE001 — degrade, never crash the run
+        ctx = None
+        if not _trace_unavailable_warned:
+            _trace_unavailable_warned = True
+            from stencil_tpu.utils.logging import log_warn
+
+            log_warn(
+                f"jax.profiler unavailable on this backend ({e!r}); "
+                f"running unprofiled — {log_dir} will hold no trace"
+            )
+    try:
         yield
+    finally:
+        if ctx is not None:
+            try:
+                ctx.__exit__(None, None, None)
+            except Exception as e:  # noqa: BLE001 — a failed trace FINALIZE
+                # (profiler died mid-capture) must not eat the run's result
+                from stencil_tpu.utils.logging import log_warn
+
+                log_warn(f"jax.profiler trace finalize failed: {e!r}")
 
 
 def _maybe_named_scope(name: str):
@@ -63,6 +99,11 @@ class SpanRecorder:
         self.epoch = time.perf_counter()
         self._lock = threading.Lock()
         self._events: List[dict] = []
+        #: (ts_us, series, value) counter samples — rendered as Chrome
+        #: counter-track ("ph":"C") events so Perfetto shows cumulative
+        #: exchange bytes / MXU flops as a throughput track under the spans
+        self._counter_samples: List[tuple] = []
+        self._counter_last: dict = {}
         self._tls = threading.local()
 
     # --- the per-thread nesting stack ----------------------------------------
@@ -100,17 +141,38 @@ class SpanRecorder:
         with self._lock:
             self._events.append(ev)
 
+    def sample_counter(self, name: str, value: float, t: float = None) -> None:
+        """Record one counter-track sample at ``t`` (a ``perf_counter``
+        value; now when None).  Consecutive identical values are dropped —
+        a flat counter contributes one point, not one per span."""
+        if t is None:
+            t = time.perf_counter()
+        ts = (t - self.epoch) * 1e6
+        with self._lock:
+            if self._counter_last.get(name) == value:
+                return
+            self._counter_last[name] = value
+            self._counter_samples.append((ts, name, value))
+
     def events(self) -> List[dict]:
         with self._lock:
             return list(self._events)
 
+    def counter_samples(self) -> List[tuple]:
+        with self._lock:
+            return list(self._counter_samples)
+
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._counter_samples.clear()
+            self._counter_last.clear()
 
     def chrome_trace_events(self, pid: int = 0) -> List[dict]:
-        """The recorded spans as Chrome trace-event dicts (complete events)."""
-        return [
+        """The recorded spans as Chrome trace-event dicts (complete events),
+        followed by the counter-track samples ("ph":"C" — Perfetto renders
+        each series as a value track alongside the spans)."""
+        out = [
             {
                 "name": e["name"],
                 "ph": "X",
@@ -122,3 +184,14 @@ class SpanRecorder:
             }
             for e in self.events()
         ]
+        out.extend(
+            {
+                "name": name,
+                "ph": "C",
+                "ts": ts,
+                "pid": pid,
+                "args": {"value": value},
+            }
+            for ts, name, value in self.counter_samples()
+        )
+        return out

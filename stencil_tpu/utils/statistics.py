@@ -65,3 +65,20 @@ class Statistics:
         xs = sorted(self._xs)
         q = len(xs) // 4
         return (xs[q] + 2 * xs[2 * q] + xs[3 * q]) / 4
+
+    def quantile(self, q: float) -> float:
+        """Linear-interpolated quantile (numpy's default method), so
+        ``quantile(0.5)`` equals ``med()`` for both parities.  The tail
+        quantiles (p95/p99) are what the trimean deliberately discards —
+        cross-round snapshot diffs need both views of a timing series."""
+        if not self._xs:
+            return math.nan
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile wants q in [0, 1], got {q!r}")
+        xs = sorted(self._xs)
+        pos = q * (len(xs) - 1)
+        lo = int(math.floor(pos))
+        hi = int(math.ceil(pos))
+        if lo == hi:
+            return xs[lo]
+        return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)

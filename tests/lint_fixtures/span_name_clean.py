@@ -1,0 +1,16 @@
+# lint-fixture: select=span-name rel=stencil_tpu/fake.py expect=clean
+# The sanctioned pattern: span labels are SPAN constants from names.py
+# (device-time attribution keys on them), and non-literal labels pass
+# through unexamined (the runtime registry is the backstop).
+from stencil_tpu import telemetry
+from stencil_tpu.telemetry import names as tm
+
+with telemetry.annotate(tm.SPAN_OVERLAP_INTERIOR):
+    pass
+with telemetry.span(tm.SPAN_STEP, histogram=tm.STEP_SECONDS):
+    pass
+telemetry.record_span(tm.SPAN_EXCHANGE, 0.0, 0.25)
+
+
+def dynamic(label):
+    return telemetry.annotate(label)  # parameterized: not a literal

@@ -1,0 +1,15 @@
+# lint-fixture: select=span-name rel=stencil_tpu/fake.py expect=span-name,span-name,bad-suppression
+# Seeded violations: a free-string annotate() scope (the device-attribution
+# gap) and a span() label that names a COUNTER constant's value (registered,
+# but not a span); a reasoned suppression silences a third site; a bare
+# suppression fails.
+from stencil_tpu import telemetry
+
+with telemetry.annotate("my.unregistered.scope"):
+    pass
+with telemetry.span("domain.exchange.bytes"):  # a counter, not a span
+    pass
+# stencil-lint: disable=span-name fixture: reasoned suppression silences the call below
+with telemetry.annotate("another.unregistered.scope"):
+    pass
+# stencil-lint: disable=span-name

@@ -1,0 +1,387 @@
+"""Tier-1: device-time attribution and roofline reports
+(stencil_tpu/telemetry/device.py + roofline.py + scripts/perf_report.py) —
+the parser/join pinned on the checked-in fixture trace under
+``tests/data/profile_fixture/`` (a ``jax.profiler``-style dump: process
+metadata rows, device complete-events carrying named-scope paths in args).
+Live capture needs a real profiler backend and is tier-2 ``slow``."""
+
+import importlib.util
+import json
+import os
+import shutil
+
+import pytest
+
+from stencil_tpu.telemetry import names
+from stencil_tpu.telemetry.device import (
+    ProfileCapture,
+    attribute_device_time,
+    device_pids,
+    find_trace_files,
+    load_trace_events,
+    merge_device_rows,
+    merge_into_chrome_trace,
+)
+from stencil_tpu.telemetry.roofline import (
+    peaks_for,
+    render_markdown,
+    roofline_report,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "data", "profile_fixture")
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fixture_events():
+    traces = find_trace_files(os.path.join(FIXTURE, "profile"))
+    assert len(traces) == 1 and traces[0].endswith(".trace.json.gz")
+    return load_trace_events(traces[0])
+
+
+# --- parsing -----------------------------------------------------------------
+
+
+class TestParse:
+    def test_load_gz_and_device_pids(self):
+        events = _fixture_events()
+        assert events, "fixture trace parsed empty"
+        pids = device_pids(events)
+        # the TPU process is a device timeline; the host CPU process is not
+        assert list(pids) == [1]
+        assert "TPU" in pids[1]
+
+    def test_corrupt_and_missing_dumps_return_empty(self, tmp_path):
+        p = tmp_path / "bad.trace.json.gz"
+        p.write_bytes(b"\x1f\x8b not really gzip")
+        assert load_trace_events(str(p)) == []
+        assert load_trace_events(str(tmp_path / "absent.trace.json")) == []
+        assert find_trace_files(str(tmp_path)) == [str(p)]
+
+    def test_bare_event_array_accepted(self, tmp_path):
+        p = tmp_path / "bare.trace.json"
+        p.write_text(json.dumps([{"ph": "X", "name": "k", "ts": 0, "dur": 1}]))
+        assert len(load_trace_events(str(p))) == 1
+
+
+# --- attribution -------------------------------------------------------------
+
+
+class TestAttribution:
+    def test_named_scopes_and_kernel_families(self):
+        """THE parser/join pin: device time lands on the overlap scopes the
+        split schedule annotates, the exchange collectives, the pack
+        kernels, and the MXU contraction — host rows in the dump count
+        toward nothing."""
+        att = attribute_device_time(_fixture_events())
+        assert att[names.SPAN_OVERLAP_INTERIOR]["device_us"] == pytest.approx(
+            800 + 700 + 150  # the interior-scope dot also carries the scope
+        )
+        assert att[names.SPAN_OVERLAP_EXTERIOR]["device_us"] == pytest.approx(400)
+        assert att["exchange"]["device_us"] == pytest.approx(40 + 260)
+        assert att["pack"]["device_us"] == pytest.approx(120 + 90)
+        assert att["mxu"]["device_us"] == pytest.approx(150)
+        # total is device-only: the 5000us host enqueue row is excluded
+        assert att["_total"]["device_us"] == pytest.approx(
+            800 + 700 + 40 + 260 + 120 + 90 + 400 + 150
+        )
+        assert att["_total"]["events"] == 8
+        assert att["_unattributed"]["events"] == 0
+
+    def test_unattributed_remainder(self):
+        events = [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 1, "name": "mystery-kernel", "ts": 0,
+             "dur": 7.0, "args": {}},
+        ]
+        att = attribute_device_time(events)
+        assert att["_unattributed"]["device_us"] == pytest.approx(7.0)
+        assert att["_total"]["device_us"] == pytest.approx(7.0)
+
+
+# --- merging into the host chrome trace --------------------------------------
+
+
+class TestMerge:
+    def test_device_rows_on_host_timeline(self):
+        """The acceptance shape: the merged trace contains DEVICE rows
+        attributed to the step.overlap.* named scopes, remapped past the
+        host pids, re-announced with process metadata, aligned to the
+        host window, original timestamps preserved in args."""
+        host = json.load(open(os.path.join(FIXTURE, "trace_0.json")))
+        merged = merge_device_rows(host["traceEvents"], _fixture_events())
+        dev_rows = [e for e in merged if e.get("pid", 0) >= 1000 and e["ph"] == "X"]
+        assert len(dev_rows) == 8
+        texts = [
+            e["name"] + " " + str(e.get("args", {})) for e in dev_rows
+        ]
+        assert any(names.SPAN_OVERLAP_INTERIOR in t for t in texts)
+        assert any(names.SPAN_OVERLAP_EXTERIOR in t for t in texts)
+        # host rows untouched, device rows shifted onto the host window
+        host_ts = [e["ts"] for e in host["traceEvents"] if e["ph"] == "X"]
+        assert min(e["ts"] for e in dev_rows) == pytest.approx(min(host_ts))
+        assert all("device_ts_us" in e["args"] for e in dev_rows)
+        metas = [e for e in merged if e.get("ph") == "M"]
+        assert any("TPU" in str(e["args"]) for e in metas)
+
+    def test_merge_into_chrome_trace_rewrites_atomically(self, tmp_path):
+        work = tmp_path / "telem"
+        shutil.copytree(FIXTURE, work)
+        chrome = str(work / "trace_0.json")
+        att = merge_into_chrome_trace(chrome, str(work / "profile"))
+        assert att is not None
+        doc = json.load(open(chrome))
+        assert any(e.get("pid", 0) >= 1000 for e in doc["traceEvents"])
+
+    def test_remerge_is_idempotent(self, tmp_path):
+        """Merging twice (perf_report --merge after a driver already
+        merged at exit) REPLACES the device rows instead of stacking a
+        second copy."""
+        work = tmp_path / "telem"
+        shutil.copytree(FIXTURE, work)
+        chrome = str(work / "trace_0.json")
+        for _ in range(2):
+            assert merge_into_chrome_trace(chrome, str(work / "profile"))
+        doc = json.load(open(chrome))
+        dev_rows = [
+            e for e in doc["traceEvents"]
+            if e.get("pid", 0) >= 1000 and e.get("ph") == "X"
+        ]
+        assert len(dev_rows) == 8  # not 16
+        metas = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+        assert len(metas) == 1  # one device process announcement, not two
+
+    def test_merge_without_device_processes_is_identity(self):
+        host = [{"name": "s", "ph": "X", "ts": 0, "dur": 1, "pid": 0, "tid": 0,
+                 "args": {}}]
+        assert merge_device_rows(host, [{"ph": "X", "pid": 5, "name": "k",
+                                         "ts": 0, "dur": 1}]) == host
+
+
+# --- the roofline join -------------------------------------------------------
+
+
+class TestRoofline:
+    def _report(self, **kw):
+        snap = json.load(open(os.path.join(FIXTURE, "metrics_0.json")))
+        return roofline_report(
+            snap, attribute_device_time(_fixture_events()), **kw
+        )
+
+    def test_join_bytes_and_flops(self):
+        r = self._report(chip="TPU v5e")
+        ex = r["phases"]["exchange"]
+        # 6291456 B over 300 us of collective time
+        assert ex["bytes"] == 6_291_456
+        assert ex["gbps"] == pytest.approx(6_291_456 / 300e-6 / 1e9, rel=1e-3)
+        assert ex["frac_of_roofline"] == pytest.approx(ex["gbps"] / 819.0, rel=1e-2)
+        mxu = r["phases"]["mxu"]
+        assert mxu["flops"] == 4_194_304_000
+        assert mxu["gflops"] == pytest.approx(
+            4_194_304_000 / 150e-6 / 1e9, rel=1e-3
+        )
+        assert r["phases"][names.SPAN_OVERLAP_INTERIOR]["share_of_device"] > 0.5
+        assert r["total_device_ms"] == pytest.approx(2.56)
+        assert r["source"] == "device"
+        json.loads(json.dumps(r))  # strict-JSON-safe
+
+    def test_measured_bandwidth_overrides_nominal(self):
+        r = self._report(chip="TPU v5e", measured_hbm_gbps=550.0)
+        assert r["peaks"]["hbm_gbps"] == 550.0
+        assert r["peaks"]["hbm_source"] == "measured"
+        nominal = peaks_for("TPU v5e")
+        assert nominal["hbm_gbps"] == 819.0 and nominal["hbm_source"] == "nominal"
+
+    def test_unknown_chip_has_null_roofline(self):
+        r = self._report(chip="cpu")
+        assert r["peaks"]["hbm_gbps"] is None
+        assert r["phases"]["exchange"]["frac_of_roofline"] is None
+        assert r["phases"]["exchange"]["gbps"] is not None  # achieved still shown
+
+    def test_markdown_rendering(self):
+        md = render_markdown(self._report(chip="TPU v5e"))
+        assert "| phase |" in md
+        assert f"`{names.SPAN_OVERLAP_INTERIOR}`" in md
+        assert "device truth" in md
+
+
+# --- scripts/perf_report.py --------------------------------------------------
+
+
+class TestPerfReportScript:
+    def test_fixture_dir_to_json_and_markdown(self, tmp_path, capsys):
+        """The acceptance flow: perf_report over a telemetry dir emits the
+        per-phase roofline JSON+markdown, and --merge puts the device rows
+        (step.overlap.* attributed) onto the host Chrome timeline."""
+        work = tmp_path / "telem"
+        shutil.copytree(FIXTURE, work)
+        mod = _load_script("perf_report")
+        rc = mod.main([str(work), "--chip", "TPU v5e", "--merge"])
+        assert rc == 0
+        report = json.load(open(work / "roofline.json"))
+        assert report["source"] == "device"
+        assert report["phases"]["exchange"]["gbps"] > 0
+        assert names.SPAN_OVERLAP_INTERIOR in report["phases"]
+        md = open(work / "roofline.md").read()
+        assert "| phase |" in md
+        merged = json.load(open(work / "trace_0.json"))
+        dev_rows = [
+            e for e in merged["traceEvents"] if e.get("pid", 0) >= 1000
+        ]
+        assert any(
+            names.SPAN_OVERLAP_INTERIOR in str(e.get("args", {}))
+            for e in dev_rows
+        )
+
+    def test_host_span_fallback_when_no_device_trace(self, tmp_path, capsys):
+        """CPU dryrun containers: no profiler dump — the report degrades
+        to host spans and says so."""
+        work = tmp_path / "telem"
+        work.mkdir()
+        shutil.copy(os.path.join(FIXTURE, "metrics_0.json"), work)
+        shutil.copy(os.path.join(FIXTURE, "trace_0.json"), work)
+        mod = _load_script("perf_report")
+        assert mod.main([str(work)]) == 0
+        report = json.load(open(work / "roofline.json"))
+        assert report["source"] == "host"
+        err = capsys.readouterr().err
+        assert "HOST spans" in err
+
+    def test_empty_dir_fails_cleanly(self, tmp_path, capsys):
+        mod = _load_script("perf_report")
+        assert mod.main([str(tmp_path)]) == 1
+
+
+# --- cadence capture ---------------------------------------------------------
+
+
+class TestProfileCapture:
+    def test_cadence(self, tmp_path):
+        one_shot = ProfileCapture(str(tmp_path), every=0)
+        assert [one_shot.want(i) for i in range(4)] == [True, False, False, False]
+        every3 = ProfileCapture(str(tmp_path), every=3)
+        assert [every3.want(i) for i in range(7)] == [
+            True, False, False, True, False, False, True,
+        ]
+
+    def test_from_env(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("STENCIL_PROFILE_DIR", raising=False)
+        monkeypatch.delenv("STENCIL_PROFILE_EVERY", raising=False)
+        assert ProfileCapture.from_env() is None
+        monkeypatch.setenv("STENCIL_PROFILE_EVERY", "5")
+        prof = ProfileCapture.from_env(dir=str(tmp_path))
+        assert prof is not None and prof.every == 5
+        monkeypatch.setenv("STENCIL_PROFILE_DIR", str(tmp_path / "env"))
+        assert ProfileCapture.from_env().dir == str(tmp_path / "env")
+        monkeypatch.setenv("STENCIL_PROFILE_EVERY", "sometimes")
+        with pytest.raises(ValueError, match="STENCIL_PROFILE_EVERY"):
+            ProfileCapture.from_env(dir=str(tmp_path))
+
+    def test_capture_accounts_and_degrades_without_profiler(
+        self, tmp_path, monkeypatch
+    ):
+        """A backend whose profiler raises still runs the captured body
+        (warn once, never crash) and the capture is still accounted —
+        the graceful-degrade contract of the tentpole."""
+        import jax
+
+        from stencil_tpu import telemetry
+
+        class _Boom:
+            def trace(self, d):
+                raise RuntimeError("no profiler on this backend")
+
+        monkeypatch.setattr(jax, "profiler", _Boom())
+        import stencil_tpu.telemetry.spans as spans_mod
+
+        monkeypatch.setattr(spans_mod, "_trace_unavailable_warned", False)
+        telemetry.reset()
+        prof = ProfileCapture(str(tmp_path / "prof"), every=0)
+        ran = []
+        with prof.maybe(0):
+            ran.append(True)
+        with prof.maybe(1):
+            ran.append(True)  # off-cadence: plain nullcontext
+        assert ran == [True, True]
+        assert prof.captures == 1
+        snap = telemetry.snapshot()
+        assert snap["counters"][names.PROFILE_CAPTURES] == 1
+        assert prof.attribution() is None  # nothing dumped -> degrade
+        events = telemetry.recent_events()
+        assert any(e["event"] == names.EVENT_PROFILE_CAPTURE for e in events)
+
+    def test_capture_window_counter_deltas(self, tmp_path, monkeypatch):
+        """The roofline numerator: a capture snapshots the analytic
+        counters at its boundaries, so work done OUTSIDE the window
+        (warmups, other bench sections) never inflates the join."""
+        import jax
+
+        from stencil_tpu import telemetry
+
+        class _Boom:
+            def trace(self, d):
+                raise RuntimeError("no profiler")
+
+        monkeypatch.setattr(jax, "profiler", _Boom())
+        telemetry.reset()
+        prof = ProfileCapture(str(tmp_path / "prof"), every=0)
+        assert prof.counters_snapshot() is None  # nothing captured yet
+        telemetry.inc(names.EXCHANGE_BYTES, 7000)  # pre-window: excluded
+        with prof.maybe(0):
+            telemetry.inc(names.EXCHANGE_BYTES, 512)
+            telemetry.inc(names.KERNEL_MXU_FLOPS, 300)
+        telemetry.inc(names.EXCHANGE_BYTES, 9000)  # post-window: excluded
+        snap = prof.counters_snapshot()
+        assert snap["counters"][names.EXCHANGE_BYTES] == 512
+        assert snap["counters"][names.KERNEL_MXU_FLOPS] == 300
+        assert snap["counters"][names.EXCHANGE_PACKED_BYTES] == 0
+
+
+# --- tier-2: live capture on a real profiler backend -------------------------
+
+
+@pytest.mark.slow
+def test_live_capture_attributes_named_scopes(tmp_path):
+    """Live ``jax.profiler`` capture of an annotated computation: the dump
+    parses and the named scope shows up in the attribution.  Skips when
+    this container's backend produces no trace dump (the graceful-degrade
+    path is pinned above)."""
+    import jax
+    import jax.numpy as jnp
+
+    from stencil_tpu import telemetry
+
+    prof = ProfileCapture(str(tmp_path / "prof"), every=0)
+
+    @jax.jit
+    def step(x):
+        with telemetry.annotate(names.SPAN_OVERLAP_INTERIOR):
+            return x * 2.0 + 1.0
+
+    x = jnp.ones((256, 256))
+    step(x).block_until_ready()  # compile outside the capture
+    with prof.maybe(0):
+        for _ in range(10):
+            x = step(x)
+        x.block_until_ready()
+    traces = find_trace_files(prof.dir)
+    if not traces:
+        pytest.skip("backend produced no profiler dump")
+    events = load_trace_events(traces[0])
+    assert events
+    att = attribute_device_time(events)
+    if att["_total"]["events"] == 0:
+        # the CPU backend dumps host-process rows only — device attribution
+        # honestly reports zero there (the degrade the tier-1 tests pin);
+        # real device rows need a TPU/GPU profiler backend
+        pytest.skip("dump has no device-process rows on this backend")
+    assert att["_total"]["device_us"] > 0

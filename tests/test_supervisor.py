@@ -277,6 +277,145 @@ class TestSupervisor:
             assert name in snap["counters"], name
 
 
+# --- flight recorder ---------------------------------------------------------
+
+
+class TestFlightRecorder:
+    """The supervised run's heartbeat + crash report (telemetry/flight.py)
+    and the ``python -m stencil_tpu.status`` renderer — the acceptance
+    pin: a run killed mid-chunk leaves a readable heartbeat and a crash
+    report with the classified cause and the last-N events."""
+
+    def _ring(self, tmp_path):
+        return str(tmp_path / "ring")
+
+    def test_completed_run_leaves_heartbeat(self, tmp_path, capsys):
+        m = _model()
+        sup = RunSupervisor(m.dd, _config(tmp_path), label="jacobi")
+        out = sup.run(6, advance=lambda n: m.step(n), chunk=1)
+        assert out.completed
+        status = json.load(open(os.path.join(self._ring(tmp_path), "status.json")))
+        assert status["phase"] == "completed"
+        assert status["step"] == 6 and status["total_steps"] == 6
+        assert status["label"] == "jacobi" and status["restarts"] == 0
+        assert status["watchdog"] == "off"
+        assert isinstance(status["rate_steps_per_s"], float)
+        assert status["checkpoint_age_s"] >= 0
+        # rendered by the status module (the `python -m stencil_tpu.status`
+        # entry point calls exactly this main)
+        from stencil_tpu.status import main as status_main
+
+        assert status_main([self._ring(tmp_path)]) == 0
+        rendered = capsys.readouterr().out
+        assert "jacobi" in rendered and "[completed]" in rendered
+        assert "6/6" in rendered
+
+    def test_fatal_exit_leaves_crash_report(self, tmp_path, capsys):
+        """A FATAL with no restart budget propagates AND leaves the
+        post-mortem: heartbeat from the last good chunk, crash report with
+        the classified cause and the injected-fault event in its tail."""
+        m = _model()
+        sup = RunSupervisor(
+            m.dd, _config(tmp_path, max_restarts=0), label="jacobi"
+        )
+        inject.set_plan("dispatch:fatal:jacobi@2*1")
+        with pytest.raises(RuntimeError, match="injected fatal"):
+            sup.run(8, advance=lambda n: m.step(n), chunk=1)
+        ring = self._ring(tmp_path)
+        status = json.load(open(os.path.join(ring, "status.json")))
+        assert status["phase"] == "running" and status["step"] == 2
+        crash = json.load(open(os.path.join(ring, "crash_report.json")))
+        assert crash["cause"] == "fatal"
+        assert "injected fatal" in crash["error"]
+        assert crash["status"]["step"] == 2
+        assert crash["counters"]["resilience.faults.injected"] >= 1
+        assert any(
+            e["event"] == "resilience.fault_injected" for e in crash["events"]
+        )
+        from stencil_tpu.status import main as status_main
+
+        assert status_main([ring]) == 0
+        rendered = capsys.readouterr().out
+        assert "crash report [fatal]" in rendered
+        assert "injected fatal" in rendered
+
+    def test_preemption_leaves_crash_report(self, tmp_path):
+        m = _model()
+        sup = RunSupervisor(m.dd, _config(tmp_path), label="jacobi")
+        inject.set_plan("dispatch:sigterm:jacobi@3*1")
+        out = sup.run(8, advance=lambda n: m.step(n), chunk=1)
+        assert out.preempted
+        ring = self._ring(tmp_path)
+        status = json.load(open(os.path.join(ring, "status.json")))
+        assert status["phase"] == "preempted"
+        crash = json.load(open(os.path.join(ring, "crash_report.json")))
+        assert crash["cause"] == "preempted"
+        assert crash["resumable_step"] == out.step
+
+    def test_restart_records_last_error_in_heartbeat(self, tmp_path):
+        """A budgeted FATAL restart keeps running — the heartbeat carries
+        the restart count and last classified error instead of a crash."""
+        m = _model()
+        sup = RunSupervisor(m.dd, _config(tmp_path), label="jacobi")
+        inject.set_plan("dispatch:fatal:jacobi@5*1")
+        out = sup.run(10, advance=lambda n: m.step(n), chunk=1)
+        assert out.completed and out.restarts == 1
+        ring = self._ring(tmp_path)
+        status = json.load(open(os.path.join(ring, "status.json")))
+        assert status["restarts"] == 1
+        assert status["last_error"].startswith("fatal:")
+        assert not os.path.exists(os.path.join(ring, "crash_report.json"))
+
+    def test_crash_report_tolerates_non_json_values(self, tmp_path):
+        """Ring events and caller state may hold non-JSON values (the
+        JSONL sink's own tolerance) — the crash path must stringify, not
+        raise: it runs inside exception handlers where a serialization
+        error would MASK the classified failure."""
+        import pathlib
+
+        from stencil_tpu import telemetry
+        from stencil_tpu.telemetry import names as tm
+        from stencil_tpu.telemetry.flight import FlightRecorder
+
+        telemetry.emit_event(tm.EVENT_RETRY, label=pathlib.Path("/dev/null"))
+        fr = FlightRecorder(str(tmp_path), label="x")
+        assert fr.heartbeat(1, 2, run_state={"p": pathlib.Path("/x")}) is not None
+        path = fr.crash_report("fatal", error="boom", extra=pathlib.Path("/y"))
+        assert path is not None
+        doc = json.load(open(path))  # strict JSON on disk
+        assert doc["cause"] == "fatal" and doc["extra"] == "/y"
+
+    def test_rate_window_resets_on_backward_step(self, tmp_path):
+        """A supervisor restore moves the step BACKWARD: the rate window
+        resets instead of reporting None/understated rates for the whole
+        post-restart window."""
+        from stencil_tpu.telemetry.flight import FlightRecorder
+
+        fr = FlightRecorder(str(tmp_path), label="x")
+        fr.heartbeat(10, 100)
+        fr.heartbeat(20, 100)
+        fr.heartbeat(5, 100)  # restored to an earlier checkpoint
+        fr.heartbeat(6, 100)
+        status = json.load(open(fr.status_path))
+        assert status["rate_steps_per_s"] is not None
+        assert status["rate_steps_per_s"] > 0
+
+    def test_status_renderer_degrades(self, tmp_path, capsys):
+        """An empty dir is exit 1 with a message, never a traceback — the
+        tool's whole job is inspecting half-dead state."""
+        from stencil_tpu.status import main as status_main
+
+        assert status_main([str(tmp_path)]) == 1
+        assert "no flight-recorder state" in capsys.readouterr().out
+        # --json on a real status doc round-trips
+        from stencil_tpu.telemetry.flight import FlightRecorder
+
+        FlightRecorder(str(tmp_path), label="x").heartbeat(1, 2)
+        assert status_main([str(tmp_path), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["status"]["step"] == 1 and doc["crash_report"] is None
+
+
 # --- driver wiring -----------------------------------------------------------
 
 

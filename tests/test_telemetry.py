@@ -109,6 +109,37 @@ class TestMetrics:
         with pytest.raises(ValueError, match="different metric kind"):
             r.histogram("domain.exchange.count")
 
+    def test_histogram_quantiles_in_snapshot(self):
+        """p50/p95/p99 ride the snapshot alongside the trimean — the tail
+        view cross-round diffs previously lost.  p50 must agree with med
+        for both parities (linear-interpolated quantiles)."""
+        r = MetricsRegistry()
+        h = r.histogram("domain.step.seconds")
+        for v in range(1, 101):  # 1..100
+            h.observe(float(v))
+        s = h.snapshot()
+        assert s["p50"] == s["med"] == pytest.approx(50.5)
+        assert s["p95"] == pytest.approx(95.05)
+        assert s["p99"] == pytest.approx(99.01)
+        assert s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+        h2 = r.histogram("domain.exchange.seconds")
+        for v in (3.0, 1.0, 2.0):  # odd count: p50 == the middle element
+            h2.observe(v)
+        s2 = h2.snapshot()
+        assert s2["p50"] == s2["med"] == 2.0
+        # empty histogram: NaN -> None, strict-JSON-safe
+        s3 = r.histogram("domain.swap.seconds").snapshot()
+        assert s3["p50"] is None and s3["p99"] is None
+        json.loads(json.dumps(r.snapshot()))
+
+    def test_quantile_validates_range(self):
+        from stencil_tpu.utils.statistics import Statistics
+
+        st = Statistics()
+        st.insert(1.0)
+        with pytest.raises(ValueError, match="quantile"):
+            st.quantile(1.5)
+
     def test_counters_live_even_when_disabled(self):
         assert not telemetry.enabled()
         telemetry.inc(names.RETRY_ATTEMPTS)
@@ -164,6 +195,33 @@ class TestSpans:
         hist = telemetry.snapshot()["histograms"][names.EXCHANGE_SECONDS]
         assert hist["count"] == 1 and hist["max"] == 0.25
 
+    def test_counter_tracks_in_chrome_trace(self, tmp_path):
+        """The metrics registry rides the trace as Chrome counter-track
+        ("ph":"C") events sampled at span records — Perfetto shows
+        cumulative exchange bytes / MXU flops as a throughput track under
+        the spans.  Identical consecutive values are deduped."""
+        telemetry.enable(dir=str(tmp_path))
+        telemetry.inc(names.EXCHANGE_BYTES, 1024)
+        with telemetry.span(names.SPAN_EXCHANGE):
+            pass
+        with telemetry.span(names.SPAN_SWAP):
+            pass  # bytes unchanged: no second sample
+        telemetry.inc(names.EXCHANGE_BYTES, 1024)
+        telemetry.inc(names.KERNEL_MXU_FLOPS, 500)
+        with telemetry.span(names.SPAN_STEP):
+            pass
+        doc = json.loads(open(telemetry.dump_chrome_trace()).read())
+        tracks = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        bytes_track = [
+            e for e in tracks if e["name"] == names.EXCHANGE_BYTES
+        ]
+        assert [e["args"]["value"] for e in bytes_track] == [1024, 2048]
+        assert all(e["ts"] >= 0 for e in tracks)
+        mxu_track = [e for e in tracks if e["name"] == names.KERNEL_MXU_FLOPS]
+        assert [e["args"]["value"] for e in mxu_track] == [0, 500]
+        # spans still render as complete events alongside the tracks
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
 
 # --- JSONL event sink --------------------------------------------------------
 
@@ -206,6 +264,151 @@ class TestEvents:
         monkeypatch.setenv("STENCIL_TELEMETRY_DIR", "/tmp")
         t.configure_from_env()  # with a dir it parses fine (still disabled)
         assert not t.enabled
+
+
+# --- the jax.profiler trace() wrapper ----------------------------------------
+
+
+class TestTraceWrapper:
+    """Pins for telemetry.spans.trace() (previously unpinned): no-op on
+    None, creates the dir up front, and survives a backend with no
+    profiler — the graceful-degrade contract device-time attribution
+    rides on (CPU dryrun containers)."""
+
+    def test_none_is_noop(self, tmp_path, monkeypatch):
+        from stencil_tpu.telemetry import trace
+
+        monkeypatch.chdir(tmp_path)
+        with trace(None):
+            pass
+        with trace(""):
+            pass
+        assert list(tmp_path.iterdir()) == []
+
+    def test_creates_the_dir(self, tmp_path):
+        from stencil_tpu.telemetry import trace
+
+        d = tmp_path / "nested" / "prof"
+        with trace(str(d)):
+            pass
+        assert d.is_dir()
+
+    def test_survives_backend_without_profiler(self, tmp_path, monkeypatch):
+        """A profiler that raises at capture start warns ONCE and runs the
+        body unprofiled; a failed finalize cannot eat the body's result."""
+        import jax
+
+        import stencil_tpu.telemetry.spans as spans_mod
+
+        class _NoProfiler:
+            def trace(self, d):
+                raise RuntimeError("profiler not supported on this backend")
+
+        monkeypatch.setattr(jax, "profiler", _NoProfiler())
+        monkeypatch.setattr(spans_mod, "_trace_unavailable_warned", False)
+        ran = []
+        for _ in range(2):
+            with spans_mod.trace(str(tmp_path / "prof")):
+                ran.append(True)
+        assert ran == [True, True]
+        assert spans_mod._trace_unavailable_warned  # warned (once)
+
+        class _FailsOnExit:
+            class _Ctx:
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *a):
+                    raise RuntimeError("finalize exploded")
+
+            def trace(self, d):
+                return self._Ctx()
+
+        monkeypatch.setattr(jax, "profiler", _FailsOnExit())
+        out = []
+        with spans_mod.trace(str(tmp_path / "prof2")):
+            out.append("body ran")
+        assert out == ["body ran"]
+
+
+# --- the in-memory event ring (the crash-report tail) ------------------------
+
+
+class TestEventRing:
+    def test_ring_records_even_when_disabled(self, tmp_path, monkeypatch):
+        """Like the counters, the flight ring stays live with telemetry
+        off — the runs whose last events matter most die unconfigured.
+        No file is ever created."""
+        monkeypatch.chdir(tmp_path)
+        assert not telemetry.enabled()
+        telemetry.emit_event(names.EVENT_RETRY, label="x", attempt=1)
+        evs = telemetry.recent_events()
+        assert len(evs) == 1
+        assert evs[0]["event"] == names.EVENT_RETRY and evs[0]["attempt"] == 1
+        assert isinstance(evs[0]["ts"], float)
+        assert list(tmp_path.iterdir()) == []
+
+    def test_ring_is_bounded_and_ordered(self):
+        for i in range(telemetry.RING_SIZE + 10):
+            telemetry.emit_event(names.EVENT_RETRY, attempt=i)
+        evs = telemetry.recent_events()
+        assert len(evs) == telemetry.RING_SIZE
+        assert evs[-1]["attempt"] == telemetry.RING_SIZE + 9  # newest last
+        assert evs[0]["attempt"] == 10  # oldest retained
+        tail = telemetry.recent_events(5)
+        assert [e["attempt"] for e in tail] == list(
+            range(telemetry.RING_SIZE + 5, telemetry.RING_SIZE + 10)
+        )
+        telemetry.reset()
+        assert telemetry.recent_events() == []
+
+
+# --- rank-tagged sink output under a simulated multi-rank run ----------------
+
+
+class TestMultiRankSink:
+    def test_per_rank_files_and_tags(self, tmp_path, monkeypatch):
+        """Each rank's sink lands in its own events_<rank>.jsonl with
+        matching rank tags — pinned by simulating the rank probe, exactly
+        what a multi-host run changes."""
+        from stencil_tpu.telemetry import events as events_mod
+
+        sinks = {}
+        for rank in (0, 1):
+            monkeypatch.setattr(events_mod, "_rank", lambda r=rank: r)
+            sink = events_mod.EventSink(str(tmp_path))
+            sink.emit(names.EVENT_RETRY, {"label": f"rank{rank}"})
+            sink.emit(names.EVENT_DESCENT, {"from_rung": "a", "to_rung": "b"})
+            sinks[rank] = sink
+        for sink in sinks.values():
+            sink.close()
+        for rank in (0, 1):
+            path = tmp_path / f"events_{rank}.jsonl"
+            assert path.exists(), f"rank {rank} sink file missing"
+            recs = [json.loads(l) for l in path.read_text().splitlines()]
+            assert len(recs) == 2
+            assert all(r["rank"] == rank for r in recs)
+            assert recs[0]["label"] == f"rank{rank}"
+
+    def test_sink_path_pinned_at_first_emit(self, tmp_path, monkeypatch):
+        """The file is keyed by the rank AT FIRST EMIT and stays stable
+        for the sink's lifetime even if the rank probe's answer changes
+        (backend init mid-run must not fork the log)."""
+        from stencil_tpu.telemetry import events as events_mod
+
+        monkeypatch.setattr(events_mod, "_rank", lambda: 3)
+        sink = events_mod.EventSink(str(tmp_path))
+        sink.emit(names.EVENT_RETRY, {"attempt": 1})
+        monkeypatch.setattr(events_mod, "_rank", lambda: 7)
+        sink.emit(names.EVENT_RETRY, {"attempt": 2})
+        sink.close()
+        assert (tmp_path / "events_3.jsonl").exists()
+        assert not (tmp_path / "events_7.jsonl").exists()
+        recs = [
+            json.loads(l)
+            for l in (tmp_path / "events_3.jsonl").read_text().splitlines()
+        ]
+        assert len(recs) == 2
 
 
 # --- the acceptance integration: fault injection -> counters + events --------

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# One-shot repo gate: source lint + program-contract verifier + tier-1
+# tests, in sequence, with a single exit code (first failure wins, but
+# every stage runs so one invocation reports everything).
+#
+#   scripts/check_all.sh                 # the full gate (what CI runs)
+#   scripts/check_all.sh --changed-only  # pre-commit fast mode: lint only
+#                                        # files changed vs HEAD, verify the
+#                                        # canonical matrix, skip tier-1
+#
+# Stages (docs/static-analysis.md):
+#   1. python -m stencil_tpu.lint       — AST rules over the source tree
+#   2. python -m stencil_tpu.analysis   — program contracts over the
+#      canonical built-program matrix (traced jaxprs, interpret/CPU mode)
+#   3. tier-1 pytest                    — the ROADMAP verify recipe
+#      (skipped under --changed-only; the two static stages are the
+#      pre-commit budget)
+set -u
+cd "$(dirname "$0")/.."
+
+CHANGED_ONLY=0
+for arg in "$@"; do
+  case "$arg" in
+    --changed-only) CHANGED_ONLY=1 ;;
+    *) echo "usage: $0 [--changed-only]" >&2; exit 2 ;;
+  esac
+done
+
+rc=0
+
+echo "== stencil-lint ==" >&2
+if [ "$CHANGED_ONLY" = 1 ]; then
+  python -m stencil_tpu.lint --changed-only || rc=1
+else
+  python -m stencil_tpu.lint || rc=1
+fi
+
+echo "== stencil-analysis (program contracts) ==" >&2
+python -m stencil_tpu.analysis || rc=1
+
+if [ "$CHANGED_ONLY" = 0 ]; then
+  echo "== tier-1 tests ==" >&2
+  JAX_PLATFORMS=cpu timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || rc=1
+fi
+
+exit $rc

@@ -1,0 +1,7 @@
+"""``python -m stencil_tpu.analysis`` — see ``analysis/cli.py``."""
+
+import sys
+
+from stencil_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,155 @@
+"""Command-line front end: ``python -m stencil_tpu.analysis``.
+
+Exit codes mirror the lint CLI: 0 clean, 1 findings, 2 usage error.
+
+The default run builds and verifies the whole canonical matrix in
+interpret/CPU mode — the CLI forces the fake-8-chip host platform BEFORE
+jax initializes, so it works on any machine (the conftest trick, owned
+here for non-pytest invocations).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+
+def _force_cpu_mesh() -> None:
+    """The canonical matrix runs on the fake 8-chip CPU fleet; set the
+    backend knobs before jax initializes (no-op if it already did — then
+    the caller is responsible, e.g. pytest's conftest)."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="stencil-analysis",
+        description=(
+            "Machine-check the traced-program invariants (split-step "
+            "overlap independence, fused exchange structure, thin-z "
+            "relayout traps, donation soundness, f32 accumulation, VMEM "
+            "budgets, span-registry drift) against the canonical built-"
+            "program matrix.  See docs/static-analysis.md 'Program "
+            "contracts'."
+        ),
+    )
+    p.add_argument(
+        "--select",
+        metavar="CONTRACT[,CONTRACT...]",
+        help="run only these contracts (comma-separated ids)",
+    )
+    p.add_argument(
+        "--program",
+        action="append",
+        metavar="LABEL",
+        help="verify only the named canonical program(s) (repeatable; "
+        "see --list-programs)",
+    )
+    p.add_argument(
+        "--fixture",
+        metavar="PATH",
+        help="verify a fixture module instead of the matrix: a .py file "
+        "defining build() -> ProgramArtifact (the contract-fixture corpus "
+        "under tests/analysis_fixtures/)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output on stdout"
+    )
+    p.add_argument(
+        "--list-contracts",
+        action="store_true",
+        help="print the contract catalog (id + rationale) and exit",
+    )
+    p.add_argument(
+        "--list-programs",
+        action="store_true",
+        help="print the canonical program matrix and exit",
+    )
+    return p
+
+
+def _load_fixture(path: str):
+    import importlib.util
+
+    name = os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(f"_analysis_fixture_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if not hasattr(mod, "build"):
+        raise ValueError(f"{path} defines no build() -> ProgramArtifact")
+    return mod.build()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from stencil_tpu.analysis import framework
+
+    args = build_parser().parse_args(argv)
+    if args.list_contracts:
+        for cls in sorted(framework.all_contracts(), key=lambda c: c.name):
+            print(f"{cls.name}: {cls.why}")
+        return 0
+    select = args.select.split(",") if args.select else None
+    if args.list_programs:
+        from stencil_tpu.analysis.programs import CANONICAL_PROGRAMS
+
+        for s in CANONICAL_PROGRAMS:
+            print(s.label)
+        return 0
+    try:  # validate ids BEFORE any jax work: unknown --select is usage
+        framework._select(select)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    _force_cpu_mesh()
+    if args.fixture:
+        try:
+            artifacts = [_load_fixture(args.fixture)]
+        except OSError as e:
+            print(
+                f"cannot read {e.filename or args.fixture}: {e.strerror}",
+                file=sys.stderr,
+            )
+            return 2
+        except ValueError as e:  # no build() in the module
+            print(str(e), file=sys.stderr)
+            return 2
+    else:
+        from stencil_tpu.analysis.programs import CANONICAL_PROGRAMS, build_matrix
+
+        if args.program:
+            known = {s.label for s in CANONICAL_PROGRAMS}
+            unknown = sorted(set(args.program) - known)
+            if unknown:
+                print(
+                    f"unknown program(s) {unknown}; known: {sorted(known)}",
+                    file=sys.stderr,
+                )
+                return 2
+        # a failure INSIDE the canonical builds is a real break, not a
+        # usage error — let it traceback instead of masking it as exit 2
+        artifacts = build_matrix(labels=args.program)
+    findings = framework.check_artifacts(artifacts, select=select)
+    if args.json:
+        print(framework.render_json(findings, programs=len(artifacts)))
+    else:
+        framework.render_human(findings)
+        if not findings:
+            print(
+                f"stencil-analysis: {len(artifacts)} program(s) verified "
+                "clean",
+                file=sys.stderr,
+            )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

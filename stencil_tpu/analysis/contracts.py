@@ -1,0 +1,397 @@
+"""The program-contract catalog — every traced-program invariant this tree
+machine-checks (docs/static-analysis.md "Program contracts").
+
+Each contract generalizes a property previously guarded by a one-off test
+walker or a source-level heuristic the tracer can defeat:
+
+* ``overlap-independence`` — the split-step schedule's latency-hiding
+  property IS a dataflow property (arxiv 2401.16677 makes the same point:
+  overlap is what the compiler's dependency graph permits).  Replaces the
+  hand-rolled taint pass ``tests/test_overlap_structural.py`` carried.
+* ``exchange-structure``  — the fused ≤6-permute one-message-per-direction
+  exchange (packer.cuh:52-69's collapse) must survive every route and any
+  quantity count.
+* ``sliver-dus``          — the thin-z relayout trap (PERF_NOTES "Thin
+  z-region access") checked on the traced program, where the source rule
+  (``lint/rules/layout_traps.py``) cannot see through helpers.
+* ``donation-soundness``  — the jaxpr-level twin of the ``donated-reuse``
+  lint rule: a donated/aliased buffer must be dead after the call.
+* ``accum-dtype``         — every contraction in a kernel jaxpr pins an
+  f32+ accumulator (the bf16-storage/f32-accumulate contract).
+* ``vmem-budget``         — the analytic footprint recomputed from the
+  traced shapes must fit the chip budget (``analysis/vmem.py``; the same
+  verdict ``tune/space.py`` and the stream ladder consult statically).
+* ``span-registry``       — every dotted named-scope label in the traced
+  program is a registered span (``telemetry/names.py ALL_SPANS``): drift
+  the source-level ``span-name`` rule cannot see through f-strings or
+  indirection falls out of device-time attribution silently.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from stencil_tpu.analysis.framework import (
+    Contract,
+    Finding,
+    ProgramArtifact,
+    register,
+)
+
+#: a z-window update narrower than this is certainly a sliver — halo and
+#: band writes are radius-sized (≤ ~6 cells); whole-interior write-backs
+#: are hundreds of lanes wide.  Below the f32 sublane extent of the (8,128)
+#: tile the DUS is guaranteed partial-tile relayout bait.
+SLIVER_Z_LIMIT = 8
+
+#: the fused-exchange bound: ≤ 2 ppermutes per axis sweep, ≤ 6 total,
+#: regardless of quantity count (SURVEY.md §7 "26-neighbor exchange")
+MAX_PERMUTES = 6
+
+
+def _exchanging(art: ProgramArtifact) -> bool:
+    return art.n_devices > 1
+
+
+@register
+class OverlapIndependence(Contract):
+    name = "overlap-independence"
+    why = (
+        "under overlap=split the step.overlap.interior pallas call must be "
+        "transitively ppermute-free (XLA cannot serialize what the dataflow "
+        "does not order); under off no pallas call may claim an overlap scope"
+    )
+
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        return art.kind in ("step", "fn") and "overlap" in art.axes
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import jaxpr as jx
+        from stencil_tpu.telemetry import names as tm
+
+        rows = jx.pallas_taint_rows(art.closed)
+        out: List[Finding] = []
+        split = art.axes.get("overlap") == "split"
+        if not split:
+            # the off schedule must not masquerade as split: no pallas call
+            # inside an overlap scope, and (on the direct exchanging route,
+            # where no pre-exchange pack kernels exist) every pass consumes
+            # the exchanged blocks — the historic sanity inverse
+            for ns, _ in rows:
+                if tm.SPAN_OVERLAP_INTERIOR in ns or tm.SPAN_OVERLAP_EXTERIOR in ns:
+                    out.append(
+                        art.finding(
+                            self.name,
+                            f"overlap=off program carries a pallas call in an "
+                            f"overlap scope: {ns!r}",
+                        )
+                    )
+            if (
+                _exchanging(art)
+                and art.axes.get("exchange_route", "direct") == "direct"
+                and not (art.plan or {}).get("z_slabs")
+            ):
+                if not rows:
+                    out.append(
+                        art.finding(
+                            self.name,
+                            "exchanging off program traced no jaxpr holding "
+                            "both ppermutes and pallas calls",
+                        )
+                    )
+                for ns, tainted in rows:
+                    if not tainted:
+                        out.append(
+                            art.finding(
+                                self.name,
+                                "off-schedule pallas call does NOT consume "
+                                f"the exchanged blocks (scope {ns!r}) — the "
+                                "taint pass is measuring an artifact",
+                            )
+                        )
+            return out
+        if not _exchanging(art):
+            return out  # nothing to overlap on one device
+        if not rows:
+            return [
+                art.finding(
+                    self.name,
+                    "split program traced no jaxpr holding both ppermutes "
+                    "and pallas calls — the schedule is not what it claims",
+                )
+            ]
+        clean_interior = [
+            ns for ns, t in rows if not t and tm.SPAN_OVERLAP_INTERIOR in ns
+        ]
+        if not clean_interior:
+            out.append(
+                art.finding(
+                    self.name,
+                    "no ppermute-free pallas call inside the "
+                    f"{tm.SPAN_OVERLAP_INTERIOR!r} scope: the interior pass "
+                    "depends on the exchange it is meant to hide; rows="
+                    f"{[(ns, t) for ns, t in rows]}",
+                )
+            )
+        exterior = [(ns, t) for ns, t in rows if tm.SPAN_OVERLAP_EXTERIOR in ns]
+        if not exterior:
+            out.append(
+                art.finding(
+                    self.name,
+                    f"split program has no {tm.SPAN_OVERLAP_EXTERIOR!r} band "
+                    "passes — nothing recomputes the boundary",
+                )
+            )
+        for ns, t in exterior:
+            if not t:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"exterior band pass at {ns!r} does not consume the "
+                        "exchanged halos — the boundary fix-up reads stale "
+                        "data",
+                    )
+                )
+        if art.axes.get("exchange_route", "direct") == "direct":
+            # the strong historic pin: with no pre-exchange pack kernels in
+            # the program, EVERY pallas call outside the interior scope must
+            # consume exchanged data
+            for ns, t in rows:
+                if not t and tm.SPAN_OVERLAP_INTERIOR not in ns:
+                    out.append(
+                        art.finding(
+                            self.name,
+                            f"pallas call outside the interior scope is "
+                            f"ppermute-free ({ns!r}) — more of the program "
+                            "than the declared interior dodges the exchange",
+                        )
+                    )
+        return out
+
+
+@register
+class ExchangeStructure(Contract):
+    name = "exchange-structure"
+    why = (
+        "every exchange route traces to <=6 ppermutes, one fused message "
+        "per direction, independent of the quantity count (the reference's "
+        "packed-buffer collapse, packer.cuh:52-69)"
+    )
+
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        if not _exchanging(art):
+            return False
+        if art.kind == "exchange":
+            return True
+        # the z-slab wavefront interleaves per-level slab permutes with the
+        # pass BY DESIGN (ROADMAP "finish the packed-exchange story") — its
+        # generic-exchange structure is pinned via the exchange artifacts
+        return art.kind in ("step", "fn") and not (art.plan or {}).get("z_slabs")
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from collections import Counter
+
+        from stencil_tpu.analysis import jaxpr as jx
+
+        out: List[Finding] = []
+        saw_any = False
+        for j in jx.walk(getattr(art.closed, "jaxpr", art.closed)):
+            pps = [e for e in j.eqns if e.primitive.name == "ppermute"]
+            if not pps:
+                continue
+            saw_any = True
+            if len(pps) > MAX_PERMUTES:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"one traced exchange issues {len(pps)} ppermutes "
+                        f"(> {MAX_PERMUTES}): the per-direction fusion is "
+                        "broken",
+                    )
+                )
+            scopes = Counter(jx.name_stack_str(e) for e in pps)
+            for ns, n in scopes.items():
+                if n > 1:
+                    out.append(
+                        art.finding(
+                            self.name,
+                            f"{n} ppermutes under one direction scope "
+                            f"({ns!r}): the per-quantity messages did not "
+                            "fuse into one buffer per direction",
+                        )
+                    )
+        if art.kind == "exchange" and not saw_any:
+            out.append(
+                art.finding(
+                    self.name,
+                    "exchange program traced no ppermute at all on a "
+                    "multi-device mesh",
+                )
+            )
+        return out
+
+
+@register
+class SliverDus(Contract):
+    name = "sliver-dus"
+    why = (
+        "no dynamic-update-slice on a big array with a z-extent below the "
+        "(8,128) tile granule — the thin-z relayout trap, checked where the "
+        "source rule cannot see through helpers (PERF_NOTES probe6)"
+    )
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import jaxpr as jx
+
+        out: List[Finding] = []
+        for e in jx.iter_eqns(art.closed):  # pallas bodies opaque: VMEM-
+            # ref updates are tile-local, not big-array relayout bait
+            if e.primitive.name == "dynamic_update_slice":
+                operand, update = e.invars[0].aval, e.invars[1].aval
+            elif e.primitive.name == "scatter":
+                # ``.at[static slices].set`` lowers to scatter on some
+                # toolchains — same window write, same relayout bait
+                operand, update = e.invars[0].aval, e.invars[-1].aval
+                if len(update.shape) != len(operand.shape):
+                    continue  # gather-style updates, not a window write
+            else:
+                continue
+            if len(operand.shape) < 3:
+                continue
+            if min(operand.shape[-3:]) < SLIVER_Z_LIMIT:
+                # a narrow STAGING buffer (the z-slab route's (x, 2m, y)
+                # slab extenders), not the big domain array — those sites
+                # carry their own reasoned source-level suppressions
+                continue
+            oz, uz = operand.shape[-1], update.shape[-1]
+            if uz < oz and uz < SLIVER_Z_LIMIT:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"{e.primitive.name} writes a {uz}-deep z window "
+                        f"of a {tuple(operand.shape)} array (scope "
+                        f"{jx.name_stack_str(e)!r}) — relayout bait on the "
+                        "(8,128) tiling; route it through the blend kernels "
+                        "(ops/halo_blend.py) or the packed exchange",
+                    )
+                )
+        return out
+
+
+@register
+class DonationSoundness(Contract):
+    name = "donation-soundness"
+    why = (
+        "every donated/aliased input in the traced program is dead after "
+        "the consuming call or rebound — the jaxpr-level twin of the "
+        "donated-reuse lint rule (SSA + anti-dependency scheduling make "
+        "the remaining hazards exact per jaxpr)"
+    )
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import jaxpr as jx
+
+        out: List[Finding] = []
+        for j in jx.walk(getattr(art.closed, "jaxpr", art.closed)):
+            for eqn, other, why in jx.donation_hazards(j):
+                where = (
+                    "the jaxpr outputs"
+                    if other == "outvars"
+                    else f"a later {other.primitive.name} eqn"
+                )
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"{eqn.primitive.name} (scope "
+                        f"{jx.name_stack_str(eqn)!r}) vs {where}: {why}",
+                    )
+                )
+        return out
+
+
+@register
+class AccumDtype(Contract):
+    name = "accum-dtype"
+    why = (
+        "every dot_general in a kernel jaxpr carries an f32+ "
+        "preferred_element_type — bf16 operands must never accumulate at "
+        "bf16 (the f32-accumulate contract, docs/tuning.md)"
+    )
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        import jax.numpy as jnp
+
+        from stencil_tpu.analysis import jaxpr as jx
+
+        out: List[Finding] = []
+        # descend into pallas kernels: the contractions live INSIDE them
+        for e in jx.iter_eqns(art.closed, opaque=()):
+            if e.primitive.name != "dot_general":
+                continue
+            pref = e.params.get("preferred_element_type")
+            ok = (
+                pref is not None
+                and jnp.issubdtype(pref, jnp.floating)
+                and jnp.dtype(pref).itemsize >= 4
+            )
+            if not ok:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"dot_general (scope {jx.name_stack_str(e)!r}) "
+                        f"carries preferred_element_type={pref!r} — the "
+                        "accumulator must be an explicit >=32-bit float",
+                    )
+                )
+        return out
+
+
+@register
+class VmemBudget(Contract):
+    name = "vmem-budget"
+    why = (
+        "the analytic per-kernel VMEM footprint, recomputed from the traced "
+        "shapes, fits the chip budget — the static form of the "
+        "compile-and-catch VMEM_OOM prune (analysis/vmem.py)"
+    )
+
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        return art.plan is not None
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import vmem
+
+        reason = vmem.check_traced(art)
+        if reason is not None:
+            return [art.finding(self.name, reason)]
+        return []
+
+
+@register
+class SpanRegistry(Contract):
+    name = "span-registry"
+    why = (
+        "every dotted named-scope label in the traced program is a "
+        "registered span (telemetry/names.py ALL_SPANS) — an unregistered "
+        "scope silently falls out of device-time attribution"
+    )
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import jaxpr as jx
+        from stencil_tpu.telemetry import names as tm
+
+        out: List[Finding] = []
+        for label in sorted(jx.scope_labels(art.closed)):
+            # dotted labels are telemetry-shaped (<subsystem>.<noun>...);
+            # undotted scopes (halo_ppermute_z_from_low) are local markers
+            # outside the attribution join
+            if "." in label and label not in tm.ALL_SPANS:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"named scope {label!r} is not a registered span — "
+                        "add it to telemetry/names.py ALL_SPANS or rename "
+                        "the scope",
+                    )
+                )
+        return out

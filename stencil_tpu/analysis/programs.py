@@ -1,0 +1,260 @@
+"""The canonical program matrix — the REAL built artifacts the contracts
+verify, swept over route × overlap × compute-unit × storage-dtype in
+interpret/CPU mode (the tier-1 gate
+``tests/test_analysis.py::test_canonical_programs_verify`` and the CLI both
+run exactly this list).
+
+Each spec builds a small realized domain on the fake 8-chip mesh (the
+conftest trick), builds the step / exchange the spec names, and traces it
+to a :class:`~stencil_tpu.analysis.framework.ProgramArtifact`.  Domains are
+16³ (or 17³ for the padded/uneven variants — a 17-cell axis over 2 shards
+forces the pad-and-mask path and, with it, the PLAIN wavefront form).
+
+Traces are taken under ``STENCIL_HALO_BLEND=1``: the blend kernels are the
+TPU-shaped lowering of the y/z halo writes (their absence on CPU would
+re-introduce the very sliver writes the ``sliver-dus`` contract hunts),
+exactly as the bitwise blend tests force it.
+
+The coverage ledger (``stencil_tpu/analysis/registry.py``) mirrors which
+axis values this matrix exercises; ``tests/test_analysis.py::
+test_registry_matches_matrix`` pins the two against each other, and the
+``contract-coverage`` lint rule fails any ops/ module growing an axis
+vocabulary past the ledger.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Iterable, List, Optional
+
+from stencil_tpu.analysis.framework import ProgramArtifact, step_artifact, trace_artifact
+
+#: devices the matrix needs (the conftest fake-8-chip fleet)
+MATRIX_DEVICES = 8
+
+
+def mean6_kernel(views, info):
+    """The canonical 7-point mean — the same kernel every structural test
+    streams (all shifts within radius 1, elementwise, separable)."""
+    out = {}
+    for name, src in views.items():
+        out[name] = (
+            src.sh(-1, 0, 0) + src.sh(1, 0, 0)
+            + src.sh(0, -1, 0) + src.sh(0, 1, 0)
+            + src.sh(0, 0, -1) + src.sh(0, 0, 1)
+        ) / 6.0
+    return out
+
+
+def mean6_kernel_mxu(views, info):
+    """The declared contraction form: in-plane taps through
+    ``PlaneView.plane_nbr_sum`` (the banded-matmul lowering)."""
+    out = {}
+    for name, src in views.items():
+        out[name] = (
+            src.sh(-1, 0, 0) + src.sh(1, 0, 0) + src.plane_nbr_sum()
+        ) / 6.0
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One canonical program: what to build and which axes it exercises."""
+
+    label: str
+    kind: str = "step"  # "step" | "exchange"
+    size: tuple = (16, 16, 16)
+    n_devices: int = MATRIX_DEVICES
+    halo_mult: int = 1
+    n_fields: int = 1
+    exchange_route: str = "direct"
+    stream_path: str = "auto"
+    overlap: str = "off"
+    compute_unit: str = "vpu"
+    storage_dtype: str = "native"
+
+    @property
+    def axes(self) -> dict:
+        return {
+            "route": self.stream_path,
+            "overlap": self.overlap,
+            "exchange_route": self.exchange_route,
+            "compute_unit": self.compute_unit,
+            "storage_dtype": self.storage_dtype,
+        }
+
+
+#: the matrix.  Route notes: at halo-mult 2 the auto plan is the z-slab
+#: wavefront; a split request re-plans to the PLAIN form, and the padded
+#: 17³ variants force the plain form under overlap=off too — so both
+#: wavefront forms, the plane baseline, and the single-device wrap route
+#: are all traced.  The z-slab entry keeps its per-level slab permutes
+#: (exchange-structure pins the generic exchange via the exchange:* entries
+#: instead — see that contract's ``applies_to``).
+CANONICAL_PROGRAMS: List[ProgramSpec] = [
+    ProgramSpec("step:wrap/off", n_devices=1),
+    ProgramSpec("step:plane/off/direct", stream_path="plane"),
+    ProgramSpec("step:plane/split/direct", stream_path="plane", overlap="split"),
+    ProgramSpec(
+        "step:plane/off/zpack_pallas",
+        stream_path="plane",
+        exchange_route="zpack_pallas",
+        n_fields=2,
+    ),
+    ProgramSpec(
+        "step:wavefront/off/direct/uneven", size=(17, 17, 17), halo_mult=2
+    ),
+    ProgramSpec("step:wavefront/off/direct/zslab", halo_mult=2, n_fields=2),
+    ProgramSpec("step:wavefront/split/direct", halo_mult=2, overlap="split"),
+    ProgramSpec(
+        "step:wavefront/split/zpack_xla",
+        halo_mult=2,
+        overlap="split",
+        exchange_route="zpack_xla",
+        n_fields=2,
+    ),
+    ProgramSpec(
+        "step:wavefront/split/direct/mxu",
+        halo_mult=2,
+        overlap="split",
+        compute_unit="mxu",
+    ),
+    ProgramSpec(
+        "step:wavefront/off/direct/bf16/uneven",
+        size=(17, 17, 17),
+        halo_mult=2,
+        storage_dtype="bf16",
+    ),
+    ProgramSpec("exchange:direct", kind="exchange", halo_mult=2, n_fields=2),
+    ProgramSpec(
+        "exchange:zpack_xla",
+        kind="exchange",
+        halo_mult=2,
+        exchange_route="zpack_xla",
+    ),
+    ProgramSpec(
+        "exchange:zpack_pallas",
+        kind="exchange",
+        halo_mult=2,
+        exchange_route="zpack_pallas",
+        n_fields=2,
+    ),
+]
+
+
+def covered_axis_values() -> dict:
+    """{axis tuple name: set of values the matrix exercises} — derived from
+    the spec list, compared against the jax-free coverage ledger by
+    ``test_registry_matches_matrix``."""
+    out = {
+        "EXCHANGE_ROUTES": set(),
+        "STREAM_OVERLAP": set(),
+        "COMPUTE_UNITS": set(),
+        "STORAGE_DTYPES": set(),
+    }
+    for s in CANONICAL_PROGRAMS:
+        out["EXCHANGE_ROUTES"].add(s.exchange_route)
+        out["STREAM_OVERLAP"].add(s.overlap)
+        out["COMPUTE_UNITS"].add(s.compute_unit)
+        out["STORAGE_DTYPES"].add(s.storage_dtype)
+    return out
+
+
+@contextlib.contextmanager
+def tpu_shaped_trace():
+    """Force the TPU-shaped lowering knobs for a CPU trace: blend kernels
+    on (their absence is a CPU-only divergence that would hide/seed sliver
+    writes the contracts pin)."""
+    # stencil-lint: disable=env-read save/restore WRITES of the knob around a trace, not a config consult — the consuming read stays validated in ops/halo_blend.py
+    prev = os.environ.get("STENCIL_HALO_BLEND")
+    # stencil-lint: disable=env-read see above: this is the write half of the save/restore
+    os.environ["STENCIL_HALO_BLEND"] = "1"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("STENCIL_HALO_BLEND", None)
+        else:
+            # stencil-lint: disable=env-read restore half of the save/restore write
+            os.environ["STENCIL_HALO_BLEND"] = prev
+
+
+def _build_domain(spec: ProgramSpec):
+    import jax
+    import jax.numpy as jnp
+
+    from stencil_tpu.core.radius import Radius
+    from stencil_tpu.domain import DistributedDomain
+
+    devices = jax.devices()
+    if len(devices) < spec.n_devices:
+        raise RuntimeError(
+            f"canonical matrix needs {spec.n_devices} devices, have "
+            f"{len(devices)} — run under the fake-8-chip CPU config "
+            "(conftest / the analysis CLI set it up)"
+        )
+    dd = DistributedDomain(*spec.size)
+    dd.set_radius(Radius.constant(1))
+    dd.set_devices(devices[: spec.n_devices])
+    if spec.n_devices > 1:
+        dd.set_exchange_route(spec.exchange_route)
+    if spec.halo_mult > 1:
+        dd.set_halo_multiplier(spec.halo_mult)
+    if spec.storage_dtype != "native":
+        dd.set_storage(spec.storage_dtype)
+    handles = [dd.add_data(f"q{i}") for i in range(spec.n_fields)]
+    dd.realize()
+    for i, h in enumerate(handles):
+        dd.init_by_coords(
+            h, lambda x, y, z, i=i: jnp.sin(0.13 * (x + 2 * y + 3 * z) + i)
+        )
+    return dd
+
+
+def build_program(spec: ProgramSpec) -> ProgramArtifact:
+    """Really build and trace one canonical program (interpret/CPU mode)."""
+    with tpu_shaped_trace():
+        dd = _build_domain(spec)
+        if spec.kind == "exchange":
+            fn = dd.make_exchange_route_fn(spec.exchange_route, donate=False)
+            return trace_artifact(
+                fn,
+                dd._curr,
+                label=spec.label,
+                kind="exchange",
+                axes=spec.axes,
+                dd=dd,
+                n_devices=spec.n_devices,
+            )
+        kw = dict(
+            engine="stream",
+            interpret=True,
+            stream_path=spec.stream_path,
+            stream_overlap=spec.overlap,
+            compute_unit=spec.compute_unit,
+        )
+        if spec.compute_unit == "mxu":
+            kw["mxu_kernel"] = mean6_kernel_mxu
+        step = dd.make_step(mean6_kernel, **kw)
+        return step_artifact(dd, step, label=spec.label, axes=spec.axes)
+
+
+def build_matrix(
+    labels: Optional[Iterable[str]] = None,
+) -> List[ProgramArtifact]:
+    """Build every canonical program (or the named subset)."""
+    wanted = set(labels) if labels is not None else None
+    if wanted is not None:
+        known = {s.label for s in CANONICAL_PROGRAMS}
+        unknown = wanted - known
+        if unknown:
+            raise ValueError(
+                f"unknown program(s) {sorted(unknown)}; known: {sorted(known)}"
+            )
+    return [
+        build_program(s)
+        for s in CANONICAL_PROGRAMS
+        if wanted is None or s.label in wanted
+    ]

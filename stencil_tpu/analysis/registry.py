@@ -1,0 +1,44 @@
+"""Canonical-matrix registry — the jax-free ground truth of WHAT the
+program-contract verifier sweeps.
+
+``stencil_tpu.analysis`` machine-checks the traced-program invariants
+against real built artifacts (docs/static-analysis.md "Program contracts"),
+and its value collapses the moment a new route ships outside the sweep: an
+exchange route or overlap schedule that no canonical program exercises is
+an unverified fast path.  This module records, per tuner axis, which ops/
+module DEFINES the axis vocabulary and which values the canonical matrix
+(``analysis/programs.py``) covers — and the ``contract-coverage`` lint rule
+(``lint/rules/contract_coverage.py``) fails any ops/ module that grows the
+vocabulary without growing the matrix.
+
+Kept deliberately jax-free (plain literals, stdlib only): the lint rules
+import it at check time, and the linter must run in milliseconds in any
+interpreter.  The analysis package itself asserts the literals against the
+real matrix (``tests/test_analysis.py::test_registry_matches_matrix``), so
+this file cannot drift from the programs it describes.
+"""
+
+from __future__ import annotations
+
+#: axis-vocabulary assignments the coverage rule watches: the NAME of the
+#: module-level tuple in ops/ -> (defining module, values the canonical
+#: matrix covers).  Growing the tuple in ops/ without growing the matching
+#: entry here (and a canonical program for the new value) fails lint.
+CANONICAL_AXES = {
+    "EXCHANGE_ROUTES": {
+        "module": "stencil_tpu/ops/exchange.py",
+        "covered": ("direct", "zpack_xla", "zpack_pallas"),
+    },
+    "STREAM_OVERLAP": {
+        "module": "stencil_tpu/ops/stream.py",
+        "covered": ("off", "split"),
+    },
+    "COMPUTE_UNITS": {
+        "module": "stencil_tpu/ops/jacobi_pallas.py",
+        "covered": ("vpu", "mxu"),
+    },
+    "STORAGE_DTYPES": {
+        "module": "stencil_tpu/ops/jacobi_pallas.py",
+        "covered": ("native", "bf16"),
+    },
+}

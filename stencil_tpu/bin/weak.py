@@ -231,6 +231,39 @@ def run_overlap(args, name: str = "weak", weak_scale: bool = True) -> dict:
             stream_overlap=ov,
         )
 
+    contracts_verified = None
+    if getattr(args, "verify", False):
+        # machine-check the property this A/B is about to measure: the
+        # split step really is ppermute-independent in its interior, the
+        # exchange really is the fused <=6-permute structure — a harness
+        # that times a broken schedule produces a confidently wrong artifact
+        from stencil_tpu import analysis
+        from stencil_tpu.analysis.programs import tpu_shaped_trace
+
+        with tpu_shaped_trace():  # verify the TPU-shaped lowering even on
+            # a CPU dryrun (blend kernels on, as production traces them)
+            arts = [
+                analysis.step_artifact(
+                    dd,
+                    steps[ov],
+                    label=f"{name}-overlap:{ov}",
+                    axes={"overlap": ov, "exchange_route": dd.exchange_route()},
+                )
+                for ov in ("off", "split")
+            ]
+        findings = analysis.check_artifacts(arts)
+        if findings:
+            for f in findings:
+                print(f.render(), file=sys.stderr)
+            raise SystemExit(
+                f"{len(findings)} program-contract finding(s) on the built "
+                "steps — refusing to measure a schedule that is not what it "
+                "claims (python -m stencil_tpu.analysis for the catalog)"
+            )
+        from stencil_tpu.analysis.framework import applied_contracts
+
+        contracts_verified = applied_contracts(arts)
+
     def make_step_run(step):
         def go(ninner):
             out = step(dd._curr, ninner)
@@ -313,6 +346,8 @@ def run_overlap(args, name: str = "weak", weak_scale: bool = True) -> dict:
             "bytes_per_exchange": dd.exchange_bytes_total(),
         },
     }
+    if contracts_verified is not None:
+        doc["contracts_verified"] = contracts_verified
     if tune_section is not None:
         doc["tune"] = tune_section
     return doc
@@ -377,6 +412,14 @@ def build_parser(name: str, overlap_flags: bool = True) -> argparse.ArgumentPars
         metavar="N",
         help="steady-state reps for the overlap A/B (alternating protocol, "
         "rep 0 dropped, median)",
+    )
+    p.add_argument(
+        "--verify",
+        action="store_true",
+        help="with --overlap: run the program-contract verifier "
+        "(stencil_tpu.analysis) over the built off/split steps before "
+        "timing them — abort instead of measuring a schedule that is not "
+        "what it claims; the JSON doc records contracts_verified",
     )
     p.add_argument(
         "--halo-mult",

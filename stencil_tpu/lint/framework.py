@@ -278,10 +278,11 @@ def _apply_suppressions(
     return out
 
 
-#: directories never linted (measurement probes, fixture corpus, caches)
+#: directories never linted (measurement probes, fixture corpora, caches)
 EXCLUDED_DIRS = (
     os.path.join("scripts", "probes"),
     os.path.join("tests", "lint_fixtures"),
+    os.path.join("tests", "analysis_fixtures"),
     "__pycache__",
 )
 

@@ -9,6 +9,7 @@ Adding a rule: write a module here with a ``@register``-decorated
 from stencil_tpu.lint.rules import (  # noqa: F401
     accum_dtype,
     artifact_write,
+    contract_coverage,
     donation,
     env_reads,
     jax_free,

@@ -1629,7 +1629,13 @@ def make_stream_step(
         plan_now = rung.state["plan"]
         from stencil_tpu.utils.logging import log_warn
 
-        if plan_now.get("compute_unit") == "mxu":
+        # key the axis step-down on the unit the rung actually RESOLVES
+        # (the build's chain, mirrored by _prospective_unit) — an env/tuned-
+        # sourced mxu leaves the plan dict unset, and keying on the dict
+        # alone would wrongly descend DEPTH for a reject that is the
+        # contraction's fault (incl. the prefilter's static band-matrix
+        # reject), violating the axis-drops-first-at-same-depth rule
+        if _prospective_unit(plan_now) == "mxu":
             # first rung down: drop the MXU contraction form at the SAME
             # depth/schedule — the band matmuls carry their own resident
             # constants and matrix-unit lowering, so a VMEM_OOM or compile
@@ -1675,7 +1681,27 @@ def make_stream_step(
         p2["compute_unit_forced"] = True
         return rung_for(p2)
 
-    ladder = DegradationLadder(rung_for(plan), lower=lower, label="stream")
+    # static VMEM prefilter (analysis/vmem.py): on real backends a rung the
+    # model already rejects descends WITHOUT compiling — the mxu twin's
+    # resident band matrices are the case plan_stream's depth gate never
+    # modeled, previously a compile-and-catch VMEM_OOM.  Interpret mode has
+    # no Mosaic and nothing to budget, so the model must not veto there.
+    prefilter = None
+    if not interpret:
+        def prefilter(rung):
+            from stencil_tpu.analysis import check_vmem
+
+            # model what build() will actually compile: the unit resolves
+            # through the same chain the build uses (_prospective_unit —
+            # env/tuned mxu folds the band matrices in, a request that
+            # structurally degrades to vpu must NOT be priced as mxu)
+            p = dict(rung.state["plan"])
+            p["compute_unit"] = _prospective_unit(p)
+            return check_vmem(dd, p)
+
+    ladder = DegradationLadder(
+        rung_for(plan), lower=lower, label="stream", prefilter=prefilter
+    )
 
     raw = dd.local_spec().raw_size()
     n_doms = dd.num_subdomains()

@@ -71,10 +71,16 @@ class DegradationLadder:
         label: str = "step",
         eager_build: bool = True,
         buffers: Optional[Callable[[], Any]] = None,
+        prefilter: Optional[Callable[[Rung], Optional[str]]] = None,
     ):
         self.label = label
         self.rung = first
         self._lower = lower
+        # a STATIC reject — ``prefilter(rung)`` returning a reason string
+        # descends without ever compiling (the analysis VMEM model's
+        # verdict, stencil_tpu/analysis/vmem.py): the compile-and-catch
+        # VMEM_OOM becomes a zero-cost descent.  None = rung may build.
+        self._prefilter = prefilter
         # the arrays whose liveness gates a re-invocation; defaults to the
         # step call's own args (call sites whose donated buffers live
         # elsewhere — e.g. the models' domain-held curr dict — pass a getter)
@@ -101,8 +107,31 @@ class DegradationLadder:
                         f"descending to {self.rung.name!r}: {e}"
                     )
 
+    def _apply_prefilter(self) -> None:
+        """Descend past every rung the static prefilter rejects — recorded
+        as a VMEM_OOM descent (it IS the VMEM model's verdict), with no
+        compile attempted.  An exhausted ladder raises the reject."""
+        if self._prefilter is None:
+            return
+        while True:
+            reason = self._prefilter(self.rung)
+            if reason is None:
+                return
+            exc = RuntimeError(f"statically prefiltered: {reason}")
+            failed = self.rung.name
+            if not self._descend(FailureClass.VMEM_OOM, exc):
+                raise exc
+            from stencil_tpu.utils.logging import log_warn
+
+            log_warn(
+                f"{self.label}: rung {failed!r} statically prefiltered "
+                f"({reason}); descending to {self.rung.name!r} without "
+                "compiling"
+            )
+
     def _ensure_built(self) -> Callable:
         if self._impl is None:
+            self._apply_prefilter()
             inject.maybe_fail("compile", f"{self.label}:{self.rung.name}")
             t0 = time.perf_counter()
             self._impl = self.rung.build()

@@ -329,4 +329,26 @@ def stream_space(dd, x_radius: int, separable: bool, static_plan: dict,
             unit="mxu")
     else:
         prefiltered += 1
-    return cands, prefiltered
+    # static VMEM verdict (analysis/vmem.py): candidates whose MODELED
+    # footprint busts the scoped-VMEM budget are pruned here, before the
+    # search pays a compile-and-catch VMEM_OOM for them.  plan_stream
+    # already depth-gates the vpu plans through the same model, so this
+    # mostly catches the twins the planner never modeled — the mxu twin's
+    # resident band matrices foremost.  The static pick always survives
+    # (it IS the no-tune fallback being defended), matching the wrap
+    # space's rule.
+    from stencil_tpu.analysis import check_vmem
+
+    kept = []
+    for c in cands:
+        is_static = (
+            all(c.get(k) == v for k, v in static_plan.items()
+                if k not in ("halo_multiplier", "alias"))
+            and c.get("overlap", "off") == "off"
+            and c.get("compute_unit", "vpu") == "vpu"
+        )
+        if not is_static and check_vmem(dd, c) is not None:
+            prefiltered += 1
+        else:
+            kept.append(c)
+    return kept, prefiltered

@@ -1,0 +1,30 @@
+# analysis-fixture: contract=accum-dtype expect=fire
+"""A broken contraction: bf16 operands through a dot with no explicit
+accumulator — XLA's default accumulates at bf16 (bf16 × bf16 → bf16),
+exactly what the f32-accumulate contract forbids.  Hidden inside a pallas
+kernel, where the analyzer must still descend."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+
+def _band_kernel(a_ref, b_ref, o_ref):
+    o_ref[...] = jnp.dot(a_ref[...], b_ref[...])  # no preferred_element_type
+
+
+def build():
+    def step(a, b):
+        return pl.pallas_call(
+            _band_kernel,
+            out_shape=jax.ShapeDtypeStruct((64, 64), jnp.bfloat16),
+            interpret=True,
+        )(a, b)
+
+    a = jax.ShapeDtypeStruct((64, 64), jnp.bfloat16)
+    b = jax.ShapeDtypeStruct((64, 64), jnp.bfloat16)
+    return analysis.trace_artifact(
+        step, a, b, label="fixture:accum-dtype-fire", kind="fn"
+    )

@@ -1,0 +1,39 @@
+# analysis-fixture: contract=donation-soundness expect=clean
+"""Sanctioned shapes: the donated buffer is dead after the call, and an
+ALIASED pallas operand is read by a later (non-aliasing) consumer — legal,
+because SSA + anti-dependency scheduling order the reader before the
+in-place write (the split schedule's blend chain relies on exactly this)."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+_scale = jax.jit(lambda x: x * 2.0, donate_argnums=0)
+
+
+def _accum_kernel(x_ref, o_ref):
+    o_ref[...] = o_ref[...] + x_ref[...]
+
+
+def _aliased_accum(b):
+    return pl.pallas_call(
+        _accum_kernel,
+        out_shape=jax.ShapeDtypeStruct(b.shape, b.dtype),
+        input_output_aliases={0: 0},
+        interpret=True,
+    )(b)
+
+
+def build():
+    def step(x):
+        updated = _aliased_accum(x)
+        pre = x * 0.5  # a plain later READ of the aliased operand: legal
+        y = _scale(updated)  # donated and dead afterward
+        return y + pre
+
+    x = jnp.zeros((32, 32), jnp.float32)
+    return analysis.trace_artifact(
+        step, x, label="fixture:donation-soundness-clean", kind="fn"
+    )

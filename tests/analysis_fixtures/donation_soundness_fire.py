@@ -1,0 +1,22 @@
+# analysis-fixture: contract=donation-soundness expect=fire
+"""A broken donation: a nested jit donates its argument, and the enclosing
+program reads the donated buffer again afterward — the donation silently
+cannot engage (the plan says in-place; the compiler double-buffers)."""
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+_scale = jax.jit(lambda x: x * 2.0, donate_argnums=0)
+
+
+def build():
+    def step(x):
+        y = _scale(x)
+        return y + x  # BROKEN: x was donated into _scale
+
+    x = jnp.zeros((32, 32), jnp.float32)
+    return analysis.trace_artifact(
+        step, x, label="fixture:donation-soundness-fire", kind="fn"
+    )

@@ -1,0 +1,52 @@
+# analysis-fixture: contract=overlap-independence expect=fire
+"""A broken split schedule: the pallas call inside the
+``step.overlap.interior`` scope CONSUMES the exchanged data — the overlap
+it claims is a lie the dataflow exposes."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stencil_tpu import analysis
+from stencil_tpu.utils.compat import shard_map
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def _pcopy(x):
+    return pl.pallas_call(
+        _copy_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True,
+    )(x)
+
+
+def build():
+    devs = jax.devices()[:8]
+    mesh = Mesh(np.array(devs), ("x",))
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+
+    def body(x):
+        recv = lax.ppermute(x, "x", perm)
+        with jax.named_scope("step.overlap.interior"):
+            a = _pcopy(recv)  # BROKEN: the interior reads exchanged data
+        with jax.named_scope("step.overlap.exterior"):
+            b = _pcopy(recv)
+        return a + b
+
+    fn = shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                   check_vma=False)
+    x = jnp.zeros((8, 16), jnp.float32)
+    return analysis.trace_artifact(
+        fn,
+        x,
+        label="fixture:overlap-independence-fire",
+        kind="fn",
+        axes={"overlap": "split", "exchange_route": "direct"},
+        n_devices=8,
+    )

@@ -1,0 +1,33 @@
+# analysis-fixture: contract=sliver-dus expect=clean
+"""Sanctioned update shapes: a whole-interior write-back (hundreds of
+lanes wide) and an x-plane slab (contiguous in the (8,128) tiling) — and a
+pallas kernel's tile-local ref update, which the analyzer must treat as
+opaque rather than mistake for big-array relayout bait."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+
+def _thin_ref_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+    o_ref[:, :, 0:2] = x_ref[:, :, 0:2] * 0.5  # tile-local, not the trap
+
+
+def build():
+    def step(b):
+        interior = b[1:-1, 1:-1, 1:-1] * 0.9
+        b = b.at[1:-1, 1:-1, 1:-1].set(interior)  # whole-interior write-back
+        b = b.at[0:2, :, :].set(b[-4:-2, :, :])  # x-plane slab: contiguous
+        return pl.pallas_call(
+            _thin_ref_kernel,
+            out_shape=jax.ShapeDtypeStruct(b.shape, b.dtype),
+            interpret=True,
+        )(b)
+
+    b = jax.ShapeDtypeStruct((64, 64, 64), jnp.float32)
+    return analysis.trace_artifact(
+        step, b, label="fixture:sliver-dus-clean", kind="fn"
+    )

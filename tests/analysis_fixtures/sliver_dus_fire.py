@@ -1,0 +1,30 @@
+# analysis-fixture: contract=sliver-dus expect=fire
+"""A broken halo write: a 2-deep z window updated in place on the big
+array — the traced form of the (8,128)-tiling relayout trap the source
+rule cannot see when the DUS hides behind a helper."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from stencil_tpu import analysis
+
+
+def _hidden_helper(b, v):
+    # the source-level sliver-dus lint rule never sees this call site as a
+    # window write — the tracer does (lowers to scatter on this toolchain)
+    return b.at[:, :, 0:2].set(v)
+
+
+def build():
+    def step(b):
+        b = _hidden_helper(b, b[:, :, -2:] * 0.5)
+        # and the explicit dynamic form of the same sliver
+        return lax.dynamic_update_slice(
+            b, b[:, :, 0:2] * 2.0, (0, 0, 62)
+        )
+
+    b = jax.ShapeDtypeStruct((64, 64, 64), jnp.float32)
+    return analysis.trace_artifact(
+        step, b, label="fixture:sliver-dus-fire", kind="fn"
+    )

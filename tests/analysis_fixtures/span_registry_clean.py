@@ -1,0 +1,23 @@
+# analysis-fixture: contract=span-registry expect=clean
+"""Sanctioned scopes: a registered span constant, and an undotted local
+marker (outside the device-time attribution join, so not the registry's
+business)."""
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+from stencil_tpu.telemetry import names as tm
+
+
+def build():
+    def step(x):
+        with jax.named_scope(tm.SPAN_OVERLAP_INTERIOR):
+            y = x * 2.0
+        with jax.named_scope("local_marker_scope"):
+            return y + 1.0
+
+    x = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    return analysis.trace_artifact(
+        step, x, label="fixture:span-registry-clean", kind="fn"
+    )

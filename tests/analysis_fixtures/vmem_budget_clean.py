@@ -1,0 +1,32 @@
+# analysis-fixture: contract=vmem-budget expect=clean
+"""The same traced program under the calibrated 100 MB budget: the modeled
+footprint fits with room — the plan a compile would accept."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def build():
+    def step(b):
+        return pl.pallas_call(
+            _copy_kernel,
+            out_shape=jax.ShapeDtypeStruct(b.shape, b.dtype),
+            interpret=True,
+        )(b)
+
+    b = jax.ShapeDtypeStruct((32, 256, 256), jnp.float32)
+    return analysis.trace_artifact(
+        step,
+        b,
+        label="fixture:vmem-budget-clean",
+        kind="fn",
+        plan={"route": "wavefront", "m": 8, "z_slabs": False},
+        vmem_budget=100 * 1024 * 1024,
+    )

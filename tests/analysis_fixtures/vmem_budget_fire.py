@@ -1,0 +1,33 @@
+# analysis-fixture: contract=vmem-budget expect=fire
+"""A broken plan: the traced pallas planes at the claimed depth model far
+more VMEM than the (fixture-pinned, tiny) budget — the case a compile on
+real TPU would discover as a Mosaic VMEM_OOM after paying for the build."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def build():
+    def step(b):
+        return pl.pallas_call(
+            _copy_kernel,
+            out_shape=jax.ShapeDtypeStruct(b.shape, b.dtype),
+            interpret=True,
+        )(b)
+
+    b = jax.ShapeDtypeStruct((32, 256, 256), jnp.float32)
+    return analysis.trace_artifact(
+        step,
+        b,
+        label="fixture:vmem-budget-fire",
+        kind="fn",
+        plan={"route": "wavefront", "m": 8, "z_slabs": False},
+        vmem_budget=1 * 1024 * 1024,  # planes model ~5 MB of ring alone
+    )

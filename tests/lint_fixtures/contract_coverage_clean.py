@@ -1,0 +1,9 @@
+# lint-fixture: select=contract-coverage rel=stencil_tpu/ops/exchange.py expect=clean
+# The sanctioned pattern: the declared vocabulary exactly matches the
+# canonical-matrix coverage ledger (stencil_tpu/analysis/registry.py) for
+# the module that owns it; non-axis module tuples are out of scope.
+
+EXCHANGE_ROUTES = ("direct", "zpack_xla", "zpack_pallas")
+
+#: unrelated module constants never consult the ledger
+SWEEP_ORDER = ("x", "y", "z")

@@ -1,0 +1,461 @@
+"""Tier-1: the program-contract verifier (``stencil_tpu.analysis``).
+
+The tentpole gate: every registered contract over the whole canonical
+route × overlap × compute-unit × storage-dtype matrix of REALLY built
+programs (interpret/CPU mode) — plus the fixture corpus proving each
+contract fires on a seeded violation and stays quiet on the sanctioned
+pattern, the coverage-ledger pin, analyzer robustness (nested loop bodies,
+donated buffers, pallas opacity), and the static-VMEM prune pins (the
+tune space's zero-compile prune and the ladder's prefilter descent).
+"""
+
+import glob
+import importlib.util
+import json
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from stencil_tpu import analysis
+from stencil_tpu.analysis import jaxpr as jx
+from stencil_tpu.analysis import programs as aprog
+from stencil_tpu.analysis import registry as aregistry
+from stencil_tpu.analysis import vmem as avmem
+from stencil_tpu.analysis.cli import main as analysis_main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURE_DIR = os.path.join(HERE, "analysis_fixtures")
+FIXTURES = sorted(glob.glob(os.path.join(FIXTURE_DIR, "*.py")))
+
+_HEADER = re.compile(r"#\s*analysis-fixture:\s*contract=(\S+)\s+expect=(\S+)")
+
+
+def _parse_header(path):
+    with open(path) as fh:
+        first = fh.readline()
+    m = _HEADER.match(first)
+    assert m, f"{path}: first line must be an analysis-fixture header"
+    return m.group(1), m.group(2)
+
+
+def _load(path):
+    name = os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(f"afix_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.build()
+
+
+# --- the gate ----------------------------------------------------------------
+
+
+def test_canonical_programs_verify():
+    """Every contract over every canonical program: the shipped tree's
+    traced programs carry no findings.  This is the acceptance gate
+    ``python -m stencil_tpu.analysis`` fronts."""
+    artifacts = aprog.build_matrix()
+    assert len(artifacts) == len(aprog.CANONICAL_PROGRAMS)
+    findings = analysis.check_artifacts(artifacts)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_registry_matches_matrix():
+    """The jax-free coverage ledger (what the contract-coverage lint rule
+    reads) cannot drift from the real matrix, in either direction — and
+    every ledger-named vocabulary really exists in its named module."""
+    covered = aprog.covered_axis_values()
+    assert set(covered) == set(aregistry.CANONICAL_AXES)
+    for axis, entry in aregistry.CANONICAL_AXES.items():
+        assert covered[axis] == set(entry["covered"]), axis
+        mod_path = entry["module"].replace("/", ".")[: -len(".py")]
+        mod = __import__(mod_path, fromlist=[axis])
+        declared = getattr(mod, axis)
+        assert set(declared) == set(entry["covered"]), (
+            f"{axis} declares {declared} but the ledger covers "
+            f"{entry['covered']} — grow the canonical matrix with the axis"
+        )
+
+
+# --- fixture corpus: every contract fires and stays quiet --------------------
+
+
+@pytest.mark.parametrize(
+    "path", FIXTURES, ids=[os.path.basename(p)[:-3] for p in FIXTURES]
+)
+def test_fixture(path):
+    if path.endswith("README.md"):
+        return
+    contract, expect = _parse_header(path)
+    art = _load(path)
+    findings = analysis.check(art, contract=contract)
+    if expect == "fire":
+        assert findings, f"{path}: expected {contract} to fire"
+    else:
+        assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_every_contract_has_fire_and_clean_fixtures():
+    names = {cls.name for cls in analysis.all_contracts()}
+    fired, cleaned = set(), set()
+    for path in FIXTURES:
+        contract, expect = _parse_header(path)
+        (fired if expect == "fire" else cleaned).add(contract)
+    assert fired == names, f"contracts without a firing fixture: {names - fired}"
+    assert cleaned == names, f"contracts without a clean fixture: {names - cleaned}"
+
+
+# --- CLI (in-process, the lint-CLI test pattern) -----------------------------
+
+
+def test_cli_list_contracts_and_exit_codes(capsys):
+    assert analysis_main(["--list-contracts"]) == 0
+    out = capsys.readouterr().out
+    for cls in analysis.all_contracts():
+        assert cls.name in out
+        assert cls.why
+    assert analysis_main(["--list-programs"]) == 0
+    out = capsys.readouterr().out
+    for spec in aprog.CANONICAL_PROGRAMS:
+        assert spec.label in out
+    assert analysis_main(["--select", "nope"]) == 2
+    assert analysis_main(["--fixture", "/nonexistent/f.py"]) == 2
+
+
+def test_cli_fixture_exit_codes():
+    fire = os.path.join(FIXTURE_DIR, "sliver_dus_fire.py")
+    clean = os.path.join(FIXTURE_DIR, "sliver_dus_clean.py")
+    assert analysis_main(["--fixture", fire, "--select", "sliver-dus"]) == 1
+    assert analysis_main(["--fixture", clean, "--select", "sliver-dus"]) == 0
+
+
+def test_cli_json_shape(capsys):
+    fire = os.path.join(FIXTURE_DIR, "span_registry_fire.py")
+    assert analysis_main(
+        ["--fixture", fire, "--select", "span-registry", "--json"]
+    ) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc) == {"findings", "count", "programs_checked", "contracts"}
+    assert doc["count"] == len(doc["findings"]) == 1
+    assert doc["findings"][0]["contract"] == "span-registry"
+    assert sorted(c.name for c in analysis.all_contracts()) == doc["contracts"]
+
+
+def test_contract_ids_are_kebab_case():
+    for cls in analysis.all_contracts():
+        assert re.fullmatch(r"[a-z][a-z0-9-]+", cls.name), cls.name
+
+
+def test_select_unknown_contract_raises():
+    art = analysis.trace_artifact(
+        lambda x: x + 1.0,
+        jax.ShapeDtypeStruct((4,), jnp.float32),
+        label="t",
+        kind="fn",
+    )
+    with pytest.raises(ValueError, match="unknown contract"):
+        analysis.check(art, contract="nope")
+
+
+# --- analyzer robustness (satellite: nested bodies, donation, opacity) -------
+
+
+def test_taint_flows_through_nested_scan_and_while():
+    """A source inside a scan/while body taints the wrapper eqn's outputs
+    (conservative flow-through), and taint entering a nested body is not
+    laundered by the wrapper."""
+    from jax import lax
+
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from stencil_tpu.utils.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("x",))
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+
+    def body(x):
+        def scan_body(carry, _):
+            return lax.ppermute(carry, "x", perm), ()
+
+        shifted, _ = lax.scan(scan_body, x, None, length=2)
+        y = shifted * 2.0  # must be tainted: the source is INSIDE the scan
+
+        def while_body(c):
+            return c + y  # taint entering the while body
+
+        z = lax.while_loop(lambda c: c.sum() < 0.0, while_body, x * 1.0)
+        return y + z
+
+    fn = shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.zeros((8, 16), jnp.float32))
+    # inside the shard_map body: the mul consuming the scan result and the
+    # while consuming y are both tainted
+    (inner,) = [
+        j
+        for j in jx.walk(closed.jaxpr)
+        if any(e.primitive.name == "scan" for e in j.eqns)
+    ]
+    rows = jx.taint_rows(
+        inner,
+        source=lambda e: e.primitive.name == "ppermute",
+        watch=lambda e: e.primitive.name in ("mul", "while"),
+    )
+    whiles = [r for r in rows if r.primitive == "while"]
+    muls = [r for r in rows if r.primitive == "mul"]
+    assert whiles and all(r.tainted for r in whiles), rows
+    # the mul on the scan output is tainted; the x * 1.0 seed is not —
+    # flow-through is conservative, not everything-taints
+    assert any(r.tainted for r in muls) and not all(r.tainted for r in muls), rows
+
+
+def test_pallas_opacity_is_conservative():
+    """Taint entering a pallas call flows through to its consumers — an
+    analyzer that descended into the kernel jaxpr (whose ref-mutation vars
+    do not map back) would lose the taint and false-negative here."""
+    import jax.experimental.pallas as pl
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from stencil_tpu.utils.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("x",))
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+
+    def copy_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def pcopy(x):
+        return pl.pallas_call(
+            copy_kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True,
+        )(x)
+
+    def body(x):
+        recv = lax.ppermute(x, "x", perm)
+        laundered = pcopy(recv)  # an opaque hop over the exchanged data
+        return pcopy(laundered)  # must STILL be tainted
+
+    fn = shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.zeros((8, 16), jnp.float32))
+    rows = jx.pallas_taint_rows(closed)
+    assert len(rows) == 2 and all(t for _, t in rows), rows
+
+
+def test_donation_hazards_on_nested_jit():
+    """The jaxpr-level donation facts: a donated-and-reused buffer is a
+    hazard; donated-and-dead is not; an aliased operand with a plain later
+    read is not (anti-dependency scheduling orders the reader first)."""
+    scale = jax.jit(lambda x: x * 2.0, donate_argnums=0)
+
+    def bad(x):
+        return scale(x) + x
+
+    def good(x):
+        return scale(x + 1.0)
+
+    x = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    bad_j = jax.make_jaxpr(bad)(x)
+    assert any(jx.donation_hazards(j) for j in jx.walk(bad_j.jaxpr))
+    good_j = jax.make_jaxpr(good)(x)
+    assert not any(jx.donation_hazards(j) for j in jx.walk(good_j.jaxpr))
+
+
+# --- the static VMEM prune (tune space + ladder) -----------------------------
+
+
+@pytest.fixture
+def tune_dir(tmp_path, monkeypatch):
+    """Hermetic tuned-config cache (the exchange-routes suite's pattern) —
+    searches run here must not persist winners into the session cache other
+    suites' auto-mode planners consult."""
+    from stencil_tpu import tune
+
+    monkeypatch.setenv("STENCIL_TUNE_CACHE", str(tmp_path))
+    monkeypatch.delenv("STENCIL_TUNE", raising=False)
+    tune.reset_memo()
+    yield tmp_path
+    tune.reset_memo()
+
+
+def _mk_dd(nq=1):
+    from stencil_tpu.core.radius import Radius
+    from stencil_tpu.domain import DistributedDomain
+
+    dd = DistributedDomain(16, 16, 16)
+    dd.set_radius(Radius.constant(1))
+    dd.set_devices(jax.devices()[:8])
+    dd.set_halo_multiplier(2)
+    hs = [dd.add_data(f"q{i}") for i in range(nq)]
+    dd.realize()
+    for i, h in enumerate(hs):
+        dd.init_by_coords(
+            h, lambda x, y, z, i=i: jnp.sin(0.1 * (x + y + z) + i)
+        )
+    return dd
+
+
+def _mxu_straddling_budget(dd, static_plan):
+    """A scoped-VMEM budget that admits every vpu-plan footprint of the
+    space but rejects the mxu twin (whose resident band matrices the
+    stream planner never modeled) — computed from the same model, so the
+    pin cannot rot with recalibration."""
+    base = {k: v for k, v in static_plan.items() if k != "halo_multiplier"}
+    vpu = dict(base)
+    mxu = dict(base, compute_unit="mxu")
+    est_vpu = avmem.check_vmem  # noqa: F841  (documented entry point)
+    raw = dd.local_spec().raw_size()
+    sizes = [dd.field_dtype(h).itemsize for h in dd._handles]
+    e_vpu = avmem.stream_plan_vmem_bytes(
+        base["m"], raw.y, raw.z, sizes, z_slabs=bool(base.get("z_slabs"))
+    )
+    e_mxu = avmem.stream_plan_vmem_bytes(
+        base["m"], raw.y, raw.z, sizes, z_slabs=bool(base.get("z_slabs")),
+        mxu=True,
+    )
+    assert e_mxu > e_vpu
+    _, margin = avmem.budget_and_margin(len(sizes))
+    return (e_vpu + e_mxu) // 2 + margin, vpu, mxu
+
+
+def test_stream_space_prunes_mxu_twin_statically(monkeypatch, tune_dir):
+    """tune/space.py consults analysis.check_vmem: the over-budget mxu twin
+    never enters the candidate list (it counts into ``prefiltered``), while
+    the static plan and its vpu siblings survive."""
+    from stencil_tpu import tune
+    from stencil_tpu.ops.stream import plan_stream
+    from stencil_tpu.tune import space
+
+    dd = _mk_dd()
+    with tune.disabled():
+        static_plan = plan_stream(dd, 1, "auto", False)
+    budget, _, mxu_plan = _mxu_straddling_budget(dd, static_plan)
+    assert analysis.check_vmem(dd, mxu_plan, budget=budget) is not None
+    monkeypatch.setenv("STENCIL_VMEM_LIMIT_BYTES", str(budget))
+    cands, prefiltered = space.stream_space(dd, 1, False, static_plan,
+                                            mxu_ok=True)
+    assert cands, "the static plan must always survive"
+    assert all(c.get("compute_unit", "vpu") != "mxu" for c in cands), cands
+    assert prefiltered >= 1
+    # control: under the calibrated default budget the twin IS a candidate
+    monkeypatch.delenv("STENCIL_VMEM_LIMIT_BYTES")
+    cands2, _ = space.stream_space(dd, 1, False, static_plan, mxu_ok=True)
+    assert any(c.get("compute_unit") == "mxu" for c in cands2), cands2
+
+
+def test_pruned_candidate_never_compiles(monkeypatch, tune_dir):
+    """The acceptance pin: a candidate the static verdict prunes gets ZERO
+    compile attempts — the search's build_run is never invoked for it
+    (previously it compiled and the Mosaic VMEM_OOM was caught at trial
+    time)."""
+    from stencil_tpu import tune
+    from stencil_tpu.ops import stream as sm
+    from stencil_tpu.tune.runners import autotune_stream
+
+    dd = _mk_dd()
+    with tune.disabled():
+        static_plan = sm.plan_stream(dd, 1, "auto", False)
+    budget, _, _ = _mxu_straddling_budget(dd, static_plan)
+    monkeypatch.setenv("STENCIL_VMEM_LIMIT_BYTES", str(budget))
+    built_plans = []
+    real_build = sm._build_stream_step
+
+    def spy(dd_, kernel, x_radius, plan, interpret, donate=True,
+            mxu_kernel=None):
+        built_plans.append(dict(plan))
+        return real_build(dd_, kernel, x_radius, plan, interpret,
+                          donate=donate, mxu_kernel=mxu_kernel)
+
+    monkeypatch.setattr(sm, "_build_stream_step", spy)
+    report = autotune_stream(
+        dd, aprog.mean6_kernel, interpret=True, reps=1, rt=0.0,
+        mxu_kernel=aprog.mean6_kernel_mxu,
+    )
+    assert built_plans, "the surviving candidates must still compile"
+    assert all(
+        p.get("compute_unit", "vpu") != "mxu" for p in built_plans
+    ), built_plans
+    assert report.pruned >= 1
+
+
+def test_ladder_prefilter_descends_without_building():
+    """resilience/ladder.py: a rung the static prefilter rejects descends
+    — recorded as a VMEM_OOM descent — with its build NEVER invoked; an
+    exhausted ladder raises the reject."""
+    from stencil_tpu.resilience.ladder import DegradationLadder, Rung
+    from stencil_tpu.resilience.taxonomy import FailureClass
+
+    calls = []
+
+    def build_a():
+        calls.append("a")
+        return lambda *a: "a"
+
+    def build_b():
+        calls.append("b")
+        return lambda *a: "b"
+
+    a = Rung(name="deep", build=build_a, state={"fits": False})
+    b = Rung(name="shallow", build=build_b, state={"fits": True})
+
+    ladder = DegradationLadder(
+        a,
+        lower=lambda rung, cls, exc: b if rung is a else None,
+        label="t",
+        prefilter=lambda rung: None if rung.state["fits"] else "over budget",
+    )
+    assert ladder.step() == "b"
+    assert calls == ["b"], "the rejected rung must never build"
+    assert ladder.descents == [("deep", FailureClass.VMEM_OOM)]
+
+    with pytest.raises(RuntimeError, match="statically prefiltered"):
+        DegradationLadder(
+            Rung(name="only", build=build_a, state={}),
+            lower=lambda *a: None,
+            label="t",
+            prefilter=lambda rung: "over budget",
+        )
+
+
+def test_check_vmem_verdicts():
+    """The public verdict: fits under the calibrated budget, rejects under
+    a tiny one, names the plan in the reason."""
+    dd = _mk_dd()
+    plan = {"route": "wavefront", "m": 2, "z_slabs": False}
+    assert analysis.check_vmem(dd, plan) is None
+    reason = analysis.check_vmem(dd, plan, budget=1024)
+    assert reason is not None and "wavefront[m=2]" in reason
+    with pytest.raises(ValueError, match="not a stream plan"):
+        analysis.check_vmem(dd, {"route": "warp"})
+
+
+# --- tier-2: the real CLI end to end -----------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_subprocess_whole_matrix(tmp_path):
+    """``python -m stencil_tpu.analysis`` exits 0 on the shipped tree (the
+    acceptance command, run exactly as CI/check_all.sh invokes it)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["STENCIL_TUNE_CACHE"] = str(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "stencil_tpu.analysis", "--json"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["count"] == 0
+    assert doc["programs_checked"] == len(aprog.CANONICAL_PROGRAMS)

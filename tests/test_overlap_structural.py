@@ -1,28 +1,32 @@
 """Tier-1: STRUCTURAL proof of the split-step schedule's independence.
 
 The cheap CPU-only complement to the tier-2 AOT scheduling proof
-(tests/test_overlap_schedule.py): walk the traced jaxpr of a built stream
-step and verify, by var-level taint propagation, that under
-``overlap=split`` the interior stream pass (the pallas call inside the
-``step.overlap.interior`` named scope) carries NO transitive data
-dependency on any ``ppermute`` result — while the exterior band passes do,
-and the ``overlap=off`` step's single pass does.  XLA cannot serialize what
-the dataflow does not order, so this is the property the latency-hiding
-scheduler needs; the AOT test then shows the real TPU compiler actually
-schedules the permutes across the pass.
+(tests/test_overlap_schedule.py) — now expressed through the shared
+program-contract verifier (``stencil_tpu.analysis``): the
+``overlap-independence`` contract walks the traced jaxpr of a really-built
+stream step and verifies, by var-level taint propagation
+(``analysis/jaxpr.py``), that under ``overlap=split`` the interior stream
+pass carries NO transitive data dependency on any ppermute — while the
+exterior band passes do, and the ``overlap=off`` step's passes all do.
+XLA cannot serialize what the dataflow does not order, so this is the
+property the latency-hiding scheduler needs; the AOT test then shows the
+real TPU compiler actually schedules the permutes across the pass.
+
+The original pins survive verbatim (clean interior exists; everything
+outside the interior scope is tainted; exterior passes exist and are
+tainted; the off schedule is all-tainted) — the hand-rolled taint walker
+and its ``Literal`` import shim moved into ``analysis/jaxpr.py`` where
+every contract shares them.
 """
 
 import jax
 import jax.numpy as jnp
 import pytest
 
+from stencil_tpu import analysis
+from stencil_tpu.analysis import jaxpr as jx
 from stencil_tpu.core.radius import Radius
 from stencil_tpu.domain import DistributedDomain
-
-try:  # jax moved core types under jax.extend over the 0.4.x line
-    from jax.extend.core import Literal
-except ImportError:  # pragma: no cover - older toolchains
-    from jax.core import Literal
 
 
 def _mk(mult=1, path="auto"):
@@ -48,49 +52,13 @@ def mean6_kernel(views, info):
     }
 
 
-def _subjaxprs(v):
-    objs = v if isinstance(v, (list, tuple)) else [v]
-    for o in objs:
-        if hasattr(o, "jaxpr") and hasattr(o, "consts"):  # ClosedJaxpr
-            yield o.jaxpr
-        elif hasattr(o, "eqns") and hasattr(o, "invars"):  # Jaxpr
-            yield o
-
-
-def _walk(jaxpr):
-    yield jaxpr
-    for eqn in jaxpr.eqns:
-        for v in eqn.params.values():
-            for j in _subjaxprs(v):
-                yield from _walk(j)
-
-
-def _pallas_taint_rows(step_jit, curr):
-    """For the (inner-most) jaxpr holding both ppermutes and pallas calls —
-    the loop body where exchange and passes live — return one
-    ``(name_stack, tainted)`` row per pallas_call, where ``tainted`` means
-    the call's inputs transitively depend on some ppermute output."""
-    closed = jax.make_jaxpr(step_jit, static_argnums=1)(curr, 1)
-    for j in _walk(closed.jaxpr):
-        prims = {e.primitive.name for e in j.eqns}
-        if "ppermute" not in prims or "pallas_call" not in prims:
-            continue
-        tainted_vars = set()
-        rows = []
-        for e in j.eqns:
-            invars = [v for v in e.invars if not isinstance(v, Literal)]
-            src_tainted = any(id(v) in tainted_vars for v in invars)
-            if e.primitive.name == "ppermute" or src_tainted:
-                tainted_vars.update(id(v) for v in e.outvars)
-            if e.primitive.name == "pallas_call":
-                rows.append((str(e.source_info.name_stack), src_tainted))
-        return rows
-    pytest.fail("no jaxpr holding both ppermute and pallas_call eqns")
-
-
-def _built(step):
-    """The underlying jitted fn of a ladder-wrapped stream step."""
-    return step._resilience.built()
+def _artifact(dd, step, overlap):
+    return analysis.step_artifact(
+        dd,
+        step,
+        label=f"test:overlap={overlap}",
+        axes={"overlap": overlap, "exchange_route": "direct"},
+    )
 
 
 @pytest.mark.parametrize(
@@ -99,13 +67,16 @@ def _built(step):
 def test_split_interior_pass_is_ppermute_free(mult, path):
     """Split step: the interior pass's pallas call reads only pre-exchange
     values (CLEAN of every ppermute), the exterior band passes consume the
-    exchanged blocks (tainted) — on both exchanging stream routes."""
+    exchanged blocks (tainted) — on both exchanging stream routes.  The
+    shared contract machine-checks it; the original row-level pins stay."""
     dd = _mk(mult=mult, path=path)
     step = dd.make_step(
         mean6_kernel, engine="stream", interpret=True,
         stream_path=path, stream_overlap="split",
     )
-    rows = _pallas_taint_rows(_built(step), dd._curr)
+    art = _artifact(dd, step, "split")
+    assert analysis.check(art, contract="overlap-independence") == []
+    rows = jx.pallas_taint_rows(art.closed)
     clean_interior = [
         ns for ns, tainted in rows
         if not tainted and "step.overlap.interior" in ns
@@ -125,9 +96,24 @@ def test_split_interior_pass_is_ppermute_free(mult, path):
 def test_off_pass_depends_on_ppermutes():
     """Sanity inverse: the off schedule's pass consumes the exchanged blocks
     — every pallas call is tainted, so the taint analysis above is measuring
-    the split, not an artifact of the tracer."""
+    the split, not an artifact of the tracer.  The contract's off branch
+    pins the same thing; a MISLABELED artifact (this off program claiming
+    split) must fire it."""
     dd = _mk(mult=2)
     step = dd.make_step(mean6_kernel, engine="stream", interpret=True,
                         stream_overlap="off")
-    rows = _pallas_taint_rows(_built(step), dd._curr)
+    art = _artifact(dd, step, "off")
+    assert analysis.check(art, contract="overlap-independence") == []
+    rows = jx.pallas_taint_rows(art.closed)
     assert rows and all(tainted for _, tainted in rows), rows
+    mislabeled = analysis.ProgramArtifact(
+        label="test:mislabeled-split",
+        kind="step",
+        closed=art.closed,
+        axes={"overlap": "split", "exchange_route": "direct"},
+        plan=art.plan,
+        dd=dd,
+        n_devices=art.n_devices,
+    )
+    findings = analysis.check(mislabeled, contract="overlap-independence")
+    assert findings, "an off schedule claiming split must fail the contract"

@@ -1,0 +1,88 @@
+"""Probe: quantify the thin-y SUBLANE amplification the ypack routes
+attack (PERF_NOTES "Thin y-region access" — the y twin of probe12d's
+thin-z measurement).
+
+For radii {1, 2, 4} at 256^3 / 384^3 / 512^3, time the y sweep of the
+exchange ALONE (``make_exchange_route_fn(axes=(1,))``) under:
+
+* ``direct``     — the sliced (X, r, Z) sublane-sliver slab;
+* ``yzpack_xla`` — the packed sublane-major (r, X, Z) message;
+* ``yzpack_pallas`` — the same message through the tile-local pallas
+  pack/unpack pipeline.
+
+All three alternate in ONE process under the burst-aware protocol
+(``tune.trial.measure_alternating``: rep-0 drop, steady-state median) —
+the same discipline as ``bench_exchange``'s route A/B, which measures the
+same comparison embedded in a full exchange.  The analytic expectation
+(PERF_NOTES): direct's y leg moves ``ceil(2r/8)*8/(2r)`` x its logical
+bytes through the big array — 4x at r=1, 2x at r=2, ~1x at r=4 on f32 —
+so the packed routes should win at small radii and go ~neutral at r>=4.
+
+Run on hardware; on CPU it only checks that the programs build.
+"""
+
+from __future__ import annotations
+
+import statistics
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from stencil_tpu.bin._common import host_round_trip_s, timed_inner_loop
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.tune.runners import _force_done
+from stencil_tpu.tune.trial import measure_alternating
+
+ROUTES = ("direct", "yzpack_xla", "yzpack_pallas")
+RADII = (1, 2, 4)
+SIZES = (256, 384, 512)
+REPS = 4
+
+
+def y_leg_runs(n: int, radius: int):
+    dd = DistributedDomain(n, n, n)
+    dd.set_radius(Radius.constant(radius))
+    dd.add_data("d0", dtype=jnp.float32)
+    dd.realize()
+    runs = []
+    for route in ROUTES:
+        fn = dd.make_exchange_route_fn(route, donate=False, axes=(1,))
+
+        @partial(jax.jit, static_argnums=1)
+        def many(arrays, s, fn=fn):
+            return lax.fori_loop(0, s, lambda _, a: fn(a), arrays)
+
+        def run(k, many=many, dd=dd):
+            out = many(dd._curr, k)
+            _force_done(next(iter(out.values())))
+
+        runs.append(run)
+    return dd, runs
+
+
+def main():
+    rt = host_round_trip_s()
+    print("size,radius," + ",".join(f"{r}_ms" for r in ROUTES) + ",amp_model")
+    for n in SIZES:
+        for radius in RADII:
+            dd, runs = y_leg_runs(n, radius)
+            _, inner = timed_inner_loop(runs[0], 4, rt, 1)
+            for run in runs[1:]:
+                run(inner)
+            rounds = measure_alternating(runs, inner, rt, REPS)
+            ms = [statistics.median(s) * 1e3 for s in rounds]
+            # f32 sublane granule 8: big-array bytes / logical bytes
+            amp = max(1.0, 8.0 / (2 * radius))
+            print(
+                f"{n},{radius},"
+                + ",".join(f"{m:.3f}" for m in ms)
+                + f",{amp:.1f}"
+            )
+            del dd, runs
+
+
+if __name__ == "__main__":
+    main()

@@ -77,7 +77,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--exchange-route",
         default="auto",
-        choices=("auto", "direct", "zpack_xla", "zpack_pallas"),
+        choices=(
+            "auto", "direct", "zpack_xla", "zpack_pallas",
+            "yzpack_xla", "yzpack_pallas",
+        ),
     )
     p.add_argument(
         "--tune",

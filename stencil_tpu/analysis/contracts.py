@@ -14,6 +14,11 @@ walker or a source-level heuristic the tracer can defeat:
 * ``sliver-dus``          — the thin-z relayout trap (PERF_NOTES "Thin
   z-region access") checked on the traced program, where the source rule
   (``lint/rules/layout_traps.py``) cannot see through helpers.
+* ``fused-halo``          — the fused unpack→blend mode's headline claim
+  (``halo="fused"``, ops/stream.py): the big array never sees a halo
+  write — no partial-window update on a raw-shaped array, no blend/unpack
+  kernel consuming a (big array, thin slab) pair; the shell data flows
+  message → VMEM patch → pass output only.
 * ``donation-soundness``  — the jaxpr-level twin of the ``donated-reuse``
   lint rule: a donated/aliased buffer must be dead after the call.
 * ``accum-dtype``         — every contraction in a kernel jaxpr pins an
@@ -275,6 +280,94 @@ class SliverDus(Contract):
                         "(ops/halo_blend.py) or the packed exchange",
                     )
                 )
+        return out
+
+
+@register
+class FusedHalo(Contract):
+    name = "fused-halo"
+    why = (
+        "under halo=fused the big array must never see a halo write: no "
+        "partial-window DUS/scatter on a raw-shaped array and no blend/"
+        "unpack kernel pairing a raw-shaped aliased block with a thin slab "
+        "— the packed messages land in the pass's VMEM planes only"
+    )
+
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        return art.kind in ("step", "fn") and art.axes.get("halo") == "fused"
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import jaxpr as jx
+
+        raw = None
+        if art.dd is not None:
+            r = art.dd.local_spec().raw_size()
+            raw = (r.x, r.y, r.z)
+
+        def is_raw(aval) -> bool:
+            shape = tuple(getattr(aval, "shape", ()))
+            if len(shape) < 3:
+                return False
+            if raw is not None:
+                return shape[-3:] == raw
+            return True  # fixtures without a domain: any big 3-D array
+
+        out: List[Finding] = []
+        for e in jx.iter_eqns(art.closed):
+            if e.primitive.name in ("dynamic_update_slice", "scatter"):
+                operand = e.invars[0].aval
+                update = (
+                    e.invars[1].aval
+                    if e.primitive.name == "dynamic_update_slice"
+                    else e.invars[-1].aval
+                )
+                if len(getattr(update, "shape", ())) != len(
+                    getattr(operand, "shape", ())
+                ):
+                    continue  # gather-style scatter, not a window write
+                if is_raw(operand) and tuple(update.shape) != tuple(operand.shape):
+                    out.append(
+                        art.finding(
+                            self.name,
+                            f"{e.primitive.name} writes a partial window of "
+                            f"a raw-shaped {tuple(operand.shape)} array "
+                            f"(scope {jx.name_stack_str(e)!r}) — the fused "
+                            "program must not write halo data into the big "
+                            "array",
+                        )
+                    )
+            elif e.primitive.name == "pallas_call":
+                # a blend/unpack kernel: a SMALL call (block + slab [+ a
+                # scalar-prefetch operand]) pairing one raw-shaped input
+                # with a strictly-smaller 3-D slab.  The fused passes carry
+                # the origin ref plus per-quantity raws AND three shell
+                # side-buffers, so they never match this signature.
+                avals = [getattr(v, "aval", None) for v in e.invars]
+                three_d = [
+                    a for a in avals if len(getattr(a, "shape", ())) == 3
+                ]
+                if len(avals) > 3 or not three_d:
+                    continue
+                raws_in = [a for a in three_d if is_raw(a)]
+                slabs = [
+                    a
+                    for a in three_d
+                    for b in raws_in
+                    if a is not b
+                    and all(x <= y for x, y in zip(a.shape, b.shape))
+                    and any(x < y for x, y in zip(a.shape, b.shape))
+                ]
+                if raws_in and slabs:
+                    out.append(
+                        art.finding(
+                            self.name,
+                            "blend/unpack-shaped pallas call (a raw-shaped "
+                            "block paired with a thin slab, scope "
+                            f"{jx.name_stack_str(e)!r}) — the fused program "
+                            "must land shells in the pass's VMEM planes, "
+                            "never back in the big array",
+                        )
+                    )
         return out
 
 

@@ -72,6 +72,7 @@ class ProgramSpec:
     exchange_route: str = "direct"
     stream_path: str = "auto"
     overlap: str = "off"
+    halo: str = "array"
     compute_unit: str = "vpu"
     storage_dtype: str = "native"
 
@@ -80,6 +81,7 @@ class ProgramSpec:
         return {
             "route": self.stream_path,
             "overlap": self.overlap,
+            "halo": self.halo,
             "exchange_route": self.exchange_route,
             "compute_unit": self.compute_unit,
             "storage_dtype": self.storage_dtype,
@@ -127,6 +129,19 @@ CANONICAL_PROGRAMS: List[ProgramSpec] = [
         halo_mult=2,
         storage_dtype="bf16",
     ),
+    ProgramSpec(
+        "step:wavefront/off/yzpack_pallas/fused",
+        halo_mult=2,
+        exchange_route="yzpack_pallas",
+        halo="fused",
+    ),
+    ProgramSpec(
+        "step:plane/off/yzpack_xla/fused",
+        stream_path="plane",
+        exchange_route="yzpack_xla",
+        halo="fused",
+        n_fields=2,
+    ),
     ProgramSpec("exchange:direct", kind="exchange", halo_mult=2, n_fields=2),
     ProgramSpec(
         "exchange:zpack_xla",
@@ -141,6 +156,19 @@ CANONICAL_PROGRAMS: List[ProgramSpec] = [
         exchange_route="zpack_pallas",
         n_fields=2,
     ),
+    ProgramSpec(
+        "exchange:yzpack_xla",
+        kind="exchange",
+        halo_mult=2,
+        exchange_route="yzpack_xla",
+        n_fields=2,
+    ),
+    ProgramSpec(
+        "exchange:yzpack_pallas",
+        kind="exchange",
+        halo_mult=2,
+        exchange_route="yzpack_pallas",
+    ),
 ]
 
 
@@ -151,12 +179,14 @@ def covered_axis_values() -> dict:
     out = {
         "EXCHANGE_ROUTES": set(),
         "STREAM_OVERLAP": set(),
+        "STREAM_HALO": set(),
         "COMPUTE_UNITS": set(),
         "STORAGE_DTYPES": set(),
     }
     for s in CANONICAL_PROGRAMS:
         out["EXCHANGE_ROUTES"].add(s.exchange_route)
         out["STREAM_OVERLAP"].add(s.overlap)
+        out["STREAM_HALO"].add(s.halo)
         out["COMPUTE_UNITS"].add(s.compute_unit)
         out["STORAGE_DTYPES"].add(s.storage_dtype)
     return out
@@ -233,6 +263,7 @@ def build_program(spec: ProgramSpec) -> ProgramArtifact:
             interpret=True,
             stream_path=spec.stream_path,
             stream_overlap=spec.overlap,
+            stream_halo=spec.halo,
             compute_unit=spec.compute_unit,
         )
         if spec.compute_unit == "mxu":

@@ -27,11 +27,21 @@ from __future__ import annotations
 CANONICAL_AXES = {
     "EXCHANGE_ROUTES": {
         "module": "stencil_tpu/ops/exchange.py",
-        "covered": ("direct", "zpack_xla", "zpack_pallas"),
+        "covered": (
+            "direct",
+            "zpack_xla",
+            "zpack_pallas",
+            "yzpack_xla",
+            "yzpack_pallas",
+        ),
     },
     "STREAM_OVERLAP": {
         "module": "stencil_tpu/ops/stream.py",
         "covered": ("off", "split"),
+    },
+    "STREAM_HALO": {
+        "module": "stencil_tpu/ops/stream.py",
+        "covered": ("array", "fused"),
     },
     "COMPUTE_UNITS": {
         "module": "stencil_tpu/ops/jacobi_pallas.py",

@@ -45,10 +45,14 @@ def stream_plan_vmem_bytes(
     z_slabs: bool = False,
     ring_itemsizes: Optional[Sequence[int]] = None,
     mxu: bool = False,
+    fused: bool = False,
 ) -> int:
     """Modeled VMEM block bytes of a stream plan (stack margin excluded —
     compare against :func:`budget_and_margin`).  The generic-engine model
-    (``stream_vmem_fits``'s accounting) plus the mxu band-matrix term."""
+    (``stream_vmem_fits``'s accounting) plus the mxu band-matrix term and,
+    under ``halo="fused"``, the double-buffered fused-shell side blocks:
+    per field, one (1, y, z) x-slab plane plus the (1, 2m, z) y and
+    (1, 2m, y) z message blocks per grid step."""
     from stencil_tpu.ops.jacobi_pallas import _padded_plane_bytes
 
     ring = list(itemsizes) if ring_itemsizes is None else list(ring_itemsizes)
@@ -58,6 +62,10 @@ def stream_plan_vmem_bytes(
         est += 4 * _padded_plane_bytes(plane_y, plane_z, it)
         if z_slabs:
             est += 4 * _padded_plane_bytes(2 * m, plane_y, it)
+        if fused:
+            est += 2 * _padded_plane_bytes(plane_y, plane_z, it)
+            est += 2 * _padded_plane_bytes(2 * m, plane_z, it)
+            est += 2 * _padded_plane_bytes(2 * m, plane_y, it)
     if mxu:
         est += _mxu_extra_bytes(plane_y, plane_z)
     return est
@@ -98,12 +106,20 @@ def check_vmem(dd, plan: dict, budget: Optional[int] = None) -> Optional[str]:
         z_slabs=bool(plan.get("z_slabs")),
         ring_itemsizes=ring_sizes,
         mxu=plan.get("compute_unit") == "mxu",
+        fused=plan.get("halo") == "fused",
     )
     cap, margin = budget_and_margin(len(itemsizes), budget)
     if est + margin > cap:
+        tags = "".join(
+            t
+            for t, on in (
+                (",mxu", plan.get("compute_unit") == "mxu"),
+                (",fused", plan.get("halo") == "fused"),
+            )
+            if on
+        )
         return (
-            f"plan {plan.get('route')}[m={m}"
-            f"{',mxu' if plan.get('compute_unit') == 'mxu' else ''}] models "
+            f"plan {plan.get('route')}[m={m}{tags}] models "
             f"{est / 1e6:.1f} MB of VMEM blocks (+{margin / 1e6:.1f} MB "
             f"stack) against the {cap / 1e6:.1f} MB budget"
         )
@@ -163,6 +179,7 @@ def check_traced(art, budget: Optional[int] = None) -> Optional[str]:
         z_slabs=bool(plan.get("z_slabs")),
         ring_itemsizes=ring_itemsizes,
         mxu=plan.get("compute_unit") == "mxu",
+        fused=plan.get("halo") == "fused",
     )
     cap, margin = budget_and_margin(
         len(itemsizes), budget if budget is not None else art.vmem_budget

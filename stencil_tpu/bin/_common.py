@@ -170,16 +170,19 @@ def add_tune_flags(p: argparse.ArgumentParser) -> None:
 
 
 def add_exchange_route_flag(p: argparse.ArgumentParser) -> None:
-    """``--exchange-route``: pin the halo exchange's z-sweep route for this
-    run (docs/tuning.md "Exchange routes").  ``auto`` (default) keeps the
-    planner resolution: ``STENCIL_EXCHANGE_ROUTE`` > tuned config > the
+    """``--exchange-route``: pin the halo exchange's y/z-sweep route for
+    this run (docs/tuning.md "Exchange routes").  ``auto`` (default) keeps
+    the planner resolution: ``STENCIL_EXCHANGE_ROUTE`` > tuned config > the
     static ``direct`` fallback."""
+    from stencil_tpu.ops.exchange import EXCHANGE_ROUTES
+
     p.add_argument(
         "--exchange-route",
         default="auto",
-        choices=("auto", "direct", "zpack_xla", "zpack_pallas"),
-        help="z-sweep exchange route: direct slabs vs the packed z-shell "
-        "message (auto = env > tuned config > direct)",
+        choices=("auto",) + EXCHANGE_ROUTES,
+        help="y/z-sweep exchange route: direct slabs vs the packed z-shell "
+        "(zpack_*) or y+z-shell (yzpack_*) messages (auto = env > tuned "
+        "config > direct)",
     )
 
 
@@ -241,6 +244,23 @@ def add_stream_overlap_flag(p: argparse.ArgumentParser) -> None:
         "split = interior pass concurrent with the shell ppermutes plus a "
         "narrow exterior fix-up (bitwise-identical; auto = env > tuned "
         "config > off)",
+    )
+
+
+def add_stream_halo_flag(p: argparse.ArgumentParser) -> None:
+    """``--stream-halo``: pin the stream engine's halo consumption mode for
+    this run (docs/tuning.md "Fused halo consumption").  ``auto`` (default)
+    keeps the planner resolution: ``STENCIL_STREAM_HALO`` > tuned config >
+    the static ``array``."""
+    p.add_argument(
+        "--stream-halo",
+        default="auto",
+        choices=("auto", "array", "fused"),
+        help="stream-engine halo consumption: array = unpack received "
+        "shells into the big arrays, fused = land the packed yzpack_* "
+        "messages directly in the pass's VMEM planes (bitwise-identical; "
+        "needs --exchange-route yzpack_*; auto = env > tuned config > "
+        "array)",
     )
 
 

@@ -58,6 +58,8 @@ def main(argv=None) -> int:
     _common.add_telemetry_flags(p)
     _common.add_tune_flags(p)
     _common.add_stream_overlap_flag(p)
+    _common.add_stream_halo_flag(p)
+    _common.add_exchange_route_flag(p)
     _common.add_kernel_axis_flags(p)
     _common.add_checkpoint_flags(p)
     args = p.parse_args(argv)
@@ -127,6 +129,10 @@ def _run(args) -> int:
         interpret=jax.default_backend() == "cpu",
         schedule=args.schedule,
         stream_overlap=args.stream_overlap,
+        stream_halo=args.stream_halo,
+        exchange_route=(
+            None if args.exchange_route == "auto" else args.exchange_route
+        ),
         **_common.kernel_axis_kwargs(args),
     )
     sim.realize()

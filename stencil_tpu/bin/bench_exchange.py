@@ -9,14 +9,18 @@ and report the reference's exact CSV (bench_exchange.cu:57-64):
 
     name,count,trimean (S),trimean (B/s),stddev,min,avg,max
 
-Beyond the reference: ``--route`` pins the z-sweep exchange route
+Beyond the reference: ``--route`` pins the y/z-sweep exchange route
 (ops/exchange.py ``EXCHANGE_ROUTES``) for the sweep, and a direct-vs-packed
 A/B section measures every engageable route under the burst-aware protocol
 (``tune.trial.measure_alternating``: alternate within one process, drop the
 post-idle-burst rep 0, steady-state median) with a per-axis (x/y/z) ms
 breakdown — so the ~64×-amplified thin-z claim (PERF_NOTES "Thin z-region
-access") is re-measurable per chip generation.  The section is emitted as
-one machine-readable JSON line on stdout (the bench.py convention).
+access") AND the ~8/(2r) sublane-amplified thin-y claim ("Thin y-region
+access") are re-measurable per chip generation.  Legs a route does not
+change (x always; y on the z-only packed routes) are measured once under
+``direct`` and shared — ``shared_legs_with_direct`` records exactly which,
+per route.  The section is emitted as one machine-readable JSON line on
+stdout (the bench.py convention).
 """
 
 from __future__ import annotations
@@ -127,6 +131,21 @@ def sweep_configs(ext, fR: int, eR: int):
     yield f"{tag}/uniform/2", Radius.constant(2)
 
 
+def _route_measured_axes(route: str) -> list:
+    """The per-axis legs a route must measure ITSELF: a leg may only be
+    shared from ``direct`` when the route compiles a byte-identical program
+    for that sweep.  The x sweep is identical on every route (nothing packs
+    x-plane slabs); the y sweep differs on the ``yzpack_*`` routes (the
+    packed sublane-major message) and the z sweep on every packed route."""
+    from stencil_tpu.ops.exchange import Y_PACK_ROUTES
+
+    if route == "direct":
+        return ["x", "y", "z"]
+    if route in Y_PACK_ROUTES:
+        return ["y", "z"]
+    return ["z"]
+
+
 def route_ab(ext, fR: int, n_quants: int, reps: int, rt: float, inner: int = 4) -> dict:
     """Direct-vs-packed steady-state A/B at the uniform radius — every
     engageable route's full exchange plus its per-axis (x/y/z) sweeps, all
@@ -135,7 +154,7 @@ def route_ab(ext, fR: int, n_quants: int, reps: int, rt: float, inner: int = 4) 
     from jax import lax
     from functools import partial
 
-    from stencil_tpu.ops.exchange import EXCHANGE_ROUTES, zpack_supported
+    from stencil_tpu.ops.exchange import EXCHANGE_ROUTES, route_supported
     from stencil_tpu.tune.runners import _force_done
     from stencil_tpu.tune.trial import measure_alternating
 
@@ -146,12 +165,13 @@ def route_ab(ext, fR: int, n_quants: int, reps: int, rt: float, inner: int = 4) 
     for i in range(n_quants):
         dd.add_data(f"d{i}", dtype=jnp.float32)
     dd.realize()
-    routes = ["direct"]
-    packed_ok = zpack_supported(
-        [h.dtype for h in dd._handles], dd._valid_last
-    )
-    if packed_ok:
-        routes += [r for r in EXCHANGE_ROUTES if r != "direct"]
+    dtypes = [h.dtype for h in dd._handles]
+    routes = [
+        r
+        for r in EXCHANGE_ROUTES
+        if r == "direct" or route_supported(r, dtypes, dd._valid_last)
+    ]
+    packed_ok = len(routes) > 1
 
     def make_run(fn):
         @partial(jax.jit, static_argnums=1)
@@ -168,16 +188,18 @@ def route_ab(ext, fR: int, n_quants: int, reps: int, rt: float, inner: int = 4) 
     for route in routes:
         labels.append((route, "all"))
         runs.append(make_run(dd.make_exchange_route_fn(route, donate=False)))
-        # the routes differ ONLY in the z sweep (halo_exchange_multi engages
-        # _zpack_sweep at axis 2 alone): x/y per-axis runs would compile
-        # byte-identical programs per route, so they are measured once under
-        # direct and shared into every route's breakdown below
-        axes = _AXES.items() if route == "direct" else [("z", _AXES["z"])]
-        for ax_name, ax in axes:
+        # a route measures only the sweeps it CHANGES; the still-identical
+        # legs (x always; y for the z-only packed routes) compile
+        # byte-identical programs and are measured once under direct, then
+        # shared into the breakdown below — with the shared legs recorded
+        # per route in ``shared_legs_with_direct``
+        for ax_name in _route_measured_axes(route):
             labels.append((route, ax_name))
             runs.append(
                 make_run(
-                    dd.make_exchange_route_fn(route, donate=False, axes=(ax,))
+                    dd.make_exchange_route_fn(
+                        route, donate=False, axes=(_AXES[ax_name],)
+                    )
                 )
             )
     # calibrate the dispatch size once on the first run (shared workload —
@@ -211,15 +233,21 @@ def route_ab(ext, fR: int, n_quants: int, reps: int, rt: float, inner: int = 4) 
             entry["ms_per_exchange"] = ms
         else:
             entry["per_axis_ms"][part] = ms
-    # packed routes share direct's x/y figures (identical programs; only z
-    # was measured per route) — the flag records the provenance
-    section["measurement_protocol"]["xy_shared_with_direct"] = True
+    # fill the unmeasured legs from direct's figures (identical programs)
+    # and record WHICH legs were shared, per route — the provenance a
+    # reader needs before trusting a leg that was never re-measured
+    shared: dict = {}
     for route, entry in section["routes"].items():
-        if route != "direct":
-            for ax_name in ("x", "y"):
-                entry["per_axis_ms"].setdefault(
-                    ax_name, section["routes"]["direct"]["per_axis_ms"][ax_name]
-                )
+        if route == "direct":
+            continue
+        shared[route] = [
+            ax for ax in ("x", "y", "z") if ax not in entry["per_axis_ms"]
+        ]
+        for ax_name in shared[route]:
+            entry["per_axis_ms"][ax_name] = section["routes"]["direct"][
+                "per_axis_ms"
+            ][ax_name]
+    section["measurement_protocol"]["shared_legs_with_direct"] = shared
     direct = section["routes"]["direct"]["ms_per_exchange"]
     section["speedup_vs_direct"] = {
         route: (direct / e["ms_per_exchange"]) if e["ms_per_exchange"] else None
@@ -238,11 +266,13 @@ def main(argv=None) -> int:
     p.add_argument("--z", type=int, default=128)
     p.add_argument("--face-radius", type=int, default=2, dest="fR")
     p.add_argument("--edge-radius", type=int, default=1, dest="eR")
+    from stencil_tpu.ops.exchange import EXCHANGE_ROUTES
+
     p.add_argument(
         "--route",
         default="auto",
-        choices=("auto", "direct", "zpack_xla", "zpack_pallas"),
-        help="z-sweep exchange route for the CSV sweep (auto = planner "
+        choices=("auto",) + EXCHANGE_ROUTES,
+        help="y/z-sweep exchange route for the CSV sweep (auto = planner "
         "resolution: env > tuned config > direct; see docs/tuning.md "
         "'Exchange routes')",
     )

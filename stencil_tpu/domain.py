@@ -314,15 +314,18 @@ class DistributedDomain:
         return self._halo_mult
 
     def set_exchange_route(self, route: Optional[str]) -> None:
-        """Pin the z-sweep exchange route (ops/exchange.py
-        ``EXCHANGE_ROUTES``: ``direct`` | ``zpack_xla`` | ``zpack_pallas``).
+        """Pin the y/z-sweep exchange route (ops/exchange.py
+        ``EXCHANGE_ROUTES``: ``direct`` | ``zpack_xla`` | ``zpack_pallas``
+        | ``yzpack_xla`` | ``yzpack_pallas``).
         ``None``/"auto" restores planner resolution: ``STENCIL_EXCHANGE_ROUTE``,
         then the tuned config (``tune.best_config`` on this domain's
         "exchange" workload key), then the static ``direct`` fallback.  An
         explicit pin — like every explicit request — never consults the
         tuner; it still steps down to ``direct`` if the packed kernels are
-        rejected at compile (the resilience ladder) or structurally cannot
-        engage (uneven z split, unsupported dtype)."""
+        rejected at compile (the resilience ladder) or NO packed sweep can
+        structurally engage (uneven packed axes, unsupported dtype) — a
+        partially engageable route runs its eligible sweeps packed and the
+        rest direct."""
         from stencil_tpu.ops.exchange import EXCHANGE_ROUTES
 
         if route in (None, "auto"):
@@ -336,7 +339,7 @@ class DistributedDomain:
         self._exchange_route_req = route
 
     def exchange_route(self) -> str:
-        """The resolved z-sweep route (meaningful after ``realize()``)."""
+        """The resolved y/z-sweep route (meaningful after ``realize()``)."""
         return self._exchange_route
 
     def set_storage(self, storage: str) -> None:
@@ -569,7 +572,7 @@ class DistributedDomain:
         or wrong persisted config must never crash a run the fallback could
         have served.  Every resolution is an ``exchange.route`` telemetry
         decision event."""
-        from stencil_tpu.ops.exchange import EXCHANGE_ROUTES, zpack_supported
+        from stencil_tpu.ops.exchange import EXCHANGE_ROUTES, route_supported
         from stencil_tpu.utils.config import env_choice
 
         route: Optional[str] = None
@@ -597,12 +600,18 @@ class DistributedDomain:
                     )
         if route is None:
             route = "direct"
-        if route != "direct" and not zpack_supported(
-            [self.field_dtype(h) for h in self._handles], self._valid_last
+        # degrade only when NO packed sweep of the route can engage (each
+        # sweep degrades independently inside the exchange — a yzpack route
+        # over an uneven y still packs its z sweep, and vice versa)
+        if not route_supported(
+            route,
+            [self.field_dtype(h) for h in self._handles],
+            self._valid_last,
         ):
             log_warn(
                 f"exchange route {route!r} ({source}) cannot engage here "
-                "(uneven z split or unsupported dtype); degrading to 'direct'"
+                "(uneven packed axes or unsupported dtype); degrading to "
+                "'direct'"
             )
             route, source = "direct", source + "/degraded"
         telemetry.emit_event(tm.EVENT_EXCHANGE_ROUTE, route=route, source=source)
@@ -933,8 +942,17 @@ class DistributedDomain:
             )
             if self._handles and self._exchange_route != "direct":
                 # analytic packed-route traffic (like the bytes model above:
-                # modeled once, an int multiply on the hot path)
-                from stencil_tpu.ops.exchange import zpack_message_stats
+                # modeled once, an int multiply on the hot path).  Each
+                # sweep counts only when it can actually engage — a yzpack
+                # route over an uneven z still packs (and counts) its y
+                # sweep, and vice versa.
+                from stencil_tpu.ops.exchange import (
+                    Y_PACK_ROUTES,
+                    ypack_message_stats,
+                    ypack_supported,
+                    zpack_message_stats,
+                    zpack_supported,
+                )
 
                 raw = self._spec.raw_size()
                 shell = self._shell_radius
@@ -943,12 +961,28 @@ class DistributedDomain:
                     for h in self._handles
                     for _ in range(h.cell_count())
                 ]
-                nbytes, kernels = zpack_message_stats(
-                    (raw.x, raw.y, raw.z),
-                    shell.axis(2, -1),
-                    shell.axis(2, +1),
-                    itemsizes,
-                )
+                dtypes = [self.field_dtype(h) for h in self._handles]
+                nbytes = kernels = 0
+                if zpack_supported(dtypes, self._valid_last):
+                    nb, nk = zpack_message_stats(
+                        (raw.x, raw.y, raw.z),
+                        shell.axis(2, -1),
+                        shell.axis(2, +1),
+                        itemsizes,
+                    )
+                    nbytes += nb
+                    kernels += nk
+                if self._exchange_route in Y_PACK_ROUTES and ypack_supported(
+                    dtypes, self._valid_last
+                ):
+                    nb, nk = ypack_message_stats(
+                        (raw.x, raw.y, raw.z),
+                        shell.axis(1, -1),
+                        shell.axis(1, +1),
+                        itemsizes,
+                    )
+                    nbytes += nb
+                    kernels += nk
                 self._packed_nbytes = nbytes * self.num_subdomains()
                 self._packed_nkernels = kernels * self.num_subdomains()
         telemetry.inc(tm.EXCHANGE_COUNT, n)
@@ -1110,6 +1144,12 @@ class DistributedDomain:
         # interior pass with no data dependency on the shell ppermutes and
         # recomputes the boundary bands from fresh halos afterward —
         # bitwise-identical to "off"; "auto" resolves env > tuned > off
+        stream_halo: str = "auto",  # stream engine: halo consumption mode
+        # (ops/stream.py STREAM_HALO): "fused" lands the packed yzpack_*
+        # exchange messages directly in the pass's level-0 VMEM planes (no
+        # big-array halo write at all) — bitwise-identical to "array";
+        # "auto" resolves env > tuned > array (docs/tuning.md "Fused halo
+        # consumption")
         compute_unit: str = "auto",  # stream engine: the level kernels'
         # execution unit (ops/jacobi_pallas COMPUTE_UNITS): "mxu" routes
         # the separable in-plane taps through banded contractions on the
@@ -1168,7 +1208,8 @@ class DistributedDomain:
                 self, kernel, x_radius=x_radius, path=stream_path,
                 separable=separable, interpret=interpret, donate=donate,
                 max_depth=stream_depth, overlap=stream_overlap,
-                compute_unit=compute_unit, mxu_kernel=mxu_kernel,
+                halo=stream_halo, compute_unit=compute_unit,
+                mxu_kernel=mxu_kernel,
             )
         if engine != "xla":
             raise ValueError(f"unknown engine {engine!r}")

@@ -68,6 +68,13 @@ class AstarothSim:
         stream_overlap: str = "auto",  # pallas engine only: the stream
         # engine's split-step overlap schedule (ops/stream.py
         # STREAM_OVERLAP; "auto" = env > tuned > static off)
+        stream_halo: str = "auto",  # pallas engine only: the stream
+        # engine's halo consumption mode (ops/stream.py STREAM_HALO;
+        # "fused" lands the packed yzpack_* messages directly in the
+        # pass's VMEM planes; "auto" = env > tuned > static array)
+        exchange_route: str = None,  # pin the halo exchange's y/z-sweep
+        # route (ops/exchange.py EXCHANGE_ROUTES; None/"auto" = env >
+        # tuned > static direct)
         compute_unit: str = "auto",  # pallas engine only: the level
         # kernels' execution unit ("vpu" | "mxu" | "auto" = env > tuned >
         # static vpu).  mxu runs ``_kernel_mxu`` — the same mean-of-6
@@ -94,6 +101,9 @@ class AstarothSim:
             raise ValueError(f"unknown schedule {schedule!r}")
         self.schedule = schedule
         self.stream_overlap = stream_overlap
+        self.stream_halo = stream_halo
+        if exchange_route not in (None, "auto"):
+            self.dd.set_exchange_route(exchange_route)
         self.compute_unit = compute_unit
         self.storage_dtype_request = storage_dtype
         self._storage_dtype = "native"
@@ -160,6 +170,7 @@ class AstarothSim:
                 separable=True,
                 interpret=self.interpret,
                 stream_overlap=self.stream_overlap,
+                stream_halo=self.stream_halo,
                 compute_unit=self.compute_unit,
                 # the declared axis-separable contraction form — what lets
                 # compute_unit=mxu engage on this kernel

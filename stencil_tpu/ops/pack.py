@@ -11,14 +11,32 @@ rules the message size (packer.cuh:91-93).
 
 TPU design: the production exchange (ops/exchange.py) has two message
 shapes.  The ``direct`` route sends slabs as sliced — XLA fuses the slicing
-into the ppermute, playing the role of the pack kernel.  The packed z-shell
-route (``zpack_xla`` / ``zpack_pallas``, a tuner axis since the
-exchange-route PR) sends the z shell through THIS module's
-``pack_zshell_*`` / ``unpack_zshell_*`` pipeline instead: on the
-(8,128)-tiled layout a thin-z sliver read/write is ~64×-amplified
-(PERF_NOTES "Thin z-region access"), so the shell leaves HBM as whole
-x-plane DMAs, is cut and transposed in VMEM, and travels lane-major as
-``(2m, Y, Xpad)`` — the big array is never touched through a thin-z window.
+into the ppermute, playing the role of the pack kernel.  The packed routes
+(``zpack_*`` / ``yzpack_*``, tuner axes since the exchange-route PRs) send
+the thin shells through THIS module's pack pipelines instead, one twin per
+shell ORIENTATION:
+
+* **z shell** (``pack_zshell_*`` / ``unpack_zshell_*``): on the
+  (8,128)-tiled layout a thin-z sliver read/write is ~64×-amplified
+  (PERF_NOTES "Thin z-region access"), so the shell leaves HBM as whole
+  x-plane DMAs, is cut and transposed in VMEM, and travels LANE-major as
+  ``(2m, Y, Xpad)`` — the thin ``2m`` extent becomes the untiled leading
+  dim, X (whole, well-shaped, lane-padded to a 128 multiple with dead
+  columns the unpack never reads) becomes the lane dim.
+* **y shell** (``pack_yshell_*`` / ``unpack_yshell_*``): the y window is a
+  SUBLANE sliver — ``2m`` rows of the 8-row (f32) sublane granule, so a
+  radius-r y exchange through the big array is ~8/(2r)-amplified
+  (PERF_NOTES "Thin y-region access").  The same move, one axis over: the
+  shell leaves HBM as whole x-planes, the row window is cut in VMEM, and
+  the message travels SUBLANE-major as ``(2m, X, Z)`` — the thin extent is
+  again the untiled leading dim, X becomes the (padding-tolerant) sublane
+  dim, and Z stays the lane dim untouched, so no explicit pad is needed
+  (ragged sublane extents are nearly free, PERF_NOTES "Ragged lane
+  extents").
+
+Both orientations keep the invariant that the BIG array is only ever read
+(and, on the pallas twins, written) as whole x-planes; the thin cut exists
+only in VMEM and in the small message buffer.
 This module also holds (a) parity of the reference's buffer-layout math
 (``PackPlan``, byte-exact with the reference incl. the 264-byte multi-dtype
 case, test_cuda_packer.cu:74-92) and (b) the ``bench-pack`` kernel
@@ -359,6 +377,96 @@ def unpack_zshell_pallas(
         kernel,
         grid=(X,),
         in_specs=[plane, pl.BlockSpec((depth, Y, 1), lambda i: (0, 0, i))],
+        out_specs=plane,
+        out_shape=jax.ShapeDtypeStruct(block.shape, block.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(block, buf)
+
+
+# --- Production y-shell pack route -------------------------------------------
+#
+# The exchange's packed y sweep (ops/exchange.py ``yzpack_*``): the y shell
+# of a (X, Y, Z) shard travels as a sublane-major ``(depth, X, Z)`` buffer.
+# Rationale (PERF_NOTES "Thin y-region access"): a (X, depth, Z) y-sliver
+# has ``depth`` sublanes — sublane-padded to the 8-row (f32) granule, every
+# read/write of it through the big array costs ~8/depth× its logical bytes.
+# Sublane-major, the thin ``depth`` extent is the untiled leading dim, X
+# becomes the sublane dim (whole; ragged sublane extents are nearly free),
+# and Z stays the lane dim untouched — no explicit pad needed, unlike the
+# z twin's lane_pad.
+
+
+def yshell_buffer_shape(block_shape, depth: int):
+    """Shape of one y-shell message buffer for a ``(X, Y, Z)`` block."""
+    X, Z = block_shape[0], block_shape[2]
+    return (depth, X, Z)
+
+
+def pack_yshell_xla(block: jax.Array, y0: int, depth: int) -> jax.Array:
+    """``block[:, y0:y0+depth, :]`` as the sublane-major ``(depth, X, Z)``
+    message buffer, via plain XLA (slice + transpose).  XLA is free to fuse
+    the reshaping into the ppermute operand — the y twin of
+    ``pack_zshell_xla``."""
+    return jnp.transpose(block[:, y0 : y0 + depth, :], (1, 0, 2))
+
+
+def yshell_to_slab(buf: jax.Array) -> jax.Array:
+    """Inverse of the pack transpose: the received ``(depth, X, Z)`` buffer
+    as an ``(X, depth, Z)`` slab — the shape the exchange's existing
+    halo-write path (blend kernel or set) consumes.  Only the small message
+    buffer is read thin-y here, never the big array."""
+    return jnp.transpose(buf, (1, 0, 2))
+
+
+def pack_yshell_pallas(
+    block: jax.Array, y0: int, depth: int, interpret: bool = False
+) -> jax.Array:
+    """Pallas y-shell pack: grid-stream whole x-planes HBM -> VMEM (lane-
+    tile-aligned movement), cut the ``[y0, y0+depth)`` row window in VMEM,
+    land each plane's rows in the ``(depth, X, Z)`` buffer.  No transpose is
+    needed (the row cut keeps Z as the lane dim), so the kernel is a pure
+    VMEM window copy — the y twin of ``pack_zshell_pallas``."""
+    from jax.experimental import pallas as pl
+
+    X, Y, Z = block.shape
+
+    def kernel(src_ref, out_ref):
+        out_ref[:, 0] = src_ref[0, y0 : y0 + depth, :]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(X,),
+        in_specs=[pl.BlockSpec((1, Y, Z), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((depth, 1, Z), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            yshell_buffer_shape(block.shape, depth), block.dtype
+        ),
+        interpret=interpret,
+    )(block)
+
+
+def unpack_yshell_pallas(
+    block: jax.Array, buf: jax.Array, y0: int, depth: int, interpret: bool = False
+) -> jax.Array:
+    """Blend a received ``(depth, X, Z)`` y-shell buffer into
+    ``block[:, y0:y0+depth, :]`` — aliased read-modify-write of whole
+    x-planes, the row patch happening in VMEM.  Like the z twin, the big
+    array is written plane-at-a-time in its native tiled layout; the
+    sublane sliver exists only inside VMEM."""
+    from jax.experimental import pallas as pl
+
+    X, Y, Z = block.shape
+
+    def kernel(blk_ref, buf_ref, out_ref):
+        out_ref[0] = blk_ref[0]
+        out_ref[0, y0 : y0 + depth, :] = buf_ref[:, 0]
+
+    plane = pl.BlockSpec((1, Y, Z), lambda i: (i, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(X,),
+        in_specs=[plane, pl.BlockSpec((depth, 1, Z), lambda i: (0, i, 0))],
         out_specs=plane,
         out_shape=jax.ShapeDtypeStruct(block.shape, block.dtype),
         input_output_aliases={0: 0},

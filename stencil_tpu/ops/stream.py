@@ -67,6 +67,31 @@ interleaves its slab permutes with the pass, so both degrade to ``off``
 with a warning.  Padded (uneven) shards ARE supported: the high-side band
 offsets ride the same traced ``n_valid`` arithmetic as the exchange's
 dynamic halo blends.
+
+**Fused unpack→blend** (``halo ∈ {array, fused}``, a tuner axis —
+docs/tuning.md "Fused halo consumption"): under the packed ``yzpack_*``
+exchange routes the macro's unpack step is redundant — the received shell
+messages are blended into the big array only so the pass can read them
+back out one plane later.  ``halo="fused"`` removes the round trip: the
+macro calls ``fused_shell_exchange`` (ops/exchange.py), which returns the
+received per-axis shell BUFFERS (corner-patched on the small buffers in
+the exchange's sweep order), and the pass consumes them as side inputs —
+each level-0 plane is patched in VMEM (x-shell planes replaced from the x
+slabs, then y rows from the sublane-major y buffer, then z columns from
+the lane-major z buffer, replaying the x→y→z sweep order) before any
+kernel level runs.  The big array is NEVER written with halo data: no
+blend kernels, no halo DUS, no unpack kernels — the generalization of the
+z-slab wavefront's bespoke zero-big-array-halo scheme to every axis of
+the plane and plain-wavefront routes.  Because the patched level-0 planes
+are bitwise equal to the unfused post-exchange planes, every pass output
+— interior AND shell — is bitwise-identical to ``halo="array"``.
+Structural gates: the ``yzpack_*`` exchange route, even shards (the pack
+cuts at static offsets), blend-supported dtypes, ``overlap=off`` (the
+split schedule's exterior bands read exchanged BLOCKS), and the plane /
+plain-wavefront routes (a z-slab plan re-plans to the plain form first,
+like split).  Ineligible requests degrade to ``array`` with a warning;
+the ladder steps ``fused``→``array`` at the same depth before any depth
+descent.
 """
 
 from __future__ import annotations
@@ -102,6 +127,14 @@ from stencil_tpu.ops.jacobi_pallas import (
 #: ``off`` = exchange-then-compute (the static fallback), ``split`` = the
 #: interior/exterior split-step schedule (see module docstring).
 STREAM_OVERLAP = ("off", "split")
+
+#: halo consumption for the exchanging stream routes — a first-class tuner
+#: axis (tune/space.py ``stream_space``; docs/tuning.md "Fused halo
+#: consumption"): ``array`` = the exchange unpacks received shells into the
+#: big arrays and the pass reads them back (the static fallback), ``fused``
+#: = the packed messages land directly in the pass's level-0 VMEM working
+#: planes and the big array never sees a halo write (see module docstring).
+STREAM_HALO = ("array", "fused")
 
 
 class PlaneView:
@@ -201,6 +234,29 @@ def _yz_coord_planes(origin_ref, Yr, Zr, off_y, off_z, gsize):
     return y_g, z_g
 
 
+def _fused_plane_patch(v, xplane, yst, zst, t, lo_y, hi_y, lo_z, hi_z):
+    """Patch one level-0 VMEM plane from the fused shell buffers, replaying
+    the exchange's sweep order x -> y -> z: replace the whole plane when
+    this is an x-shell position (``t`` is the threshold-iota row bound —
+    the plane height at shell positions, 0 otherwise: the broadcast-compare
+    pattern the dynamic blend kernels use), then land the y rows from the
+    sublane-major buffer and the z columns from the lane-major one.
+    Shared by the plane and wavefront passes (``fused_shell`` mode)."""
+    Y, Z = v.shape
+    rowv = lax.broadcasted_iota(jnp.int32, (Y, Z), 0)
+    colv = lax.broadcasted_iota(jnp.int32, (Y, Z), 1)
+    v = jnp.where(rowv < t, xplane, v)
+    for j in range(lo_y):
+        v = jnp.where(rowv == j, yst[j][None, :], v)
+    for j in range(hi_y):
+        v = jnp.where(rowv == Y - hi_y + j, yst[lo_y + j][None, :], v)
+    for j in range(lo_z):
+        v = jnp.where(colv == j, zst[j][:, None], v)
+    for j in range(hi_z):
+        v = jnp.where(colv == Z - hi_z + j, zst[lo_z + j][:, None], v)
+    return v
+
+
 def stream_plane_pass(
     kernel: PlaneKernel,
     names: Sequence[str],
@@ -216,12 +272,22 @@ def stream_plane_pass(
     f32_accumulate: bool = False,  # bf16-storage variant: planes upcast to
     # f32 for the kernel, one downcast at the interior store (pass-through
     # shell planes keep their storage bytes bit-exact)
+    fused_shell=None,  # (xbufs, ybufs, zbufs) per quantity — the packed
+    # halo messages land in the level-0 planes in VMEM instead of having
+    # been unpacked into the blocks (halo="fused"; see module docstring)
 ) -> List[jax.Array]:
     """ONE kernel level over shell-carrying blocks, streaming x-planes with a
     ``2r``-deep ring per quantity; shell planes and the in-plane shell ring
     pass through unchanged (the exchange owns halo cells).  Generalizes
     ``mean6_plane_step``/``jacobi_plane_step`` to user kernels, any field
-    count, and any ``r >= 1``."""
+    count, and any ``r >= 1``.
+
+    With ``fused_shell`` the blocks' shell cells are STALE and the fresh
+    halos ride as side inputs (``fused_shell_exchange``'s buffers): every
+    loaded plane is patched in VMEM — x-shell planes replaced from the x
+    slabs, then y rows, then z columns, replaying the exchange's sweep
+    order — before it feeds the ring, the kernel, or the pass-through, so
+    the pass is bitwise-identical to running over exchanged blocks."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -245,10 +311,28 @@ def stream_plane_pass(
             refs = refs[: nq] + refs[nq + 2 :]
         else:
             bands = None
+        if fused_shell is not None:
+            xs_refs = refs[nq : 2 * nq]
+            ys_refs = refs[2 * nq : 3 * nq]
+            zs_refs = refs[3 * nq : 4 * nq]
+            refs = refs[:nq] + refs[4 * nq :]
         out_refs = refs[nq : 2 * nq]
         rings = refs[2 * nq :]
         i = pl.program_id(0)
         curs = [ref[0] for ref in in_refs]
+        if fused_shell is not None:
+            # level-0 VMEM patch (module docstring; _fused_plane_patch)
+            ip = jnp.minimum(i, X - 1)  # the replayed last-plane refetches
+            t = jnp.where(
+                jnp.logical_or(ip < lo.x, ip >= X - hi.x),
+                jnp.int32(Y),
+                jnp.int32(0),
+            )
+            for q in range(nq):
+                curs[q] = _fused_plane_patch(
+                    curs[q], xs_refs[q][0], ys_refs[q][0], zs_refs[q][0],
+                    t, lo.y, hi.y, lo.z, hi.z,
+                )
 
         y_g, z_g = _yz_coord_planes(origin_ref, Y, Z, lo.y, lo.z, gsize)
 
@@ -317,6 +401,40 @@ def stream_plane_pass(
             pl.BlockSpec((Z, Z), lambda i: (0, 0)),
         ]
         args += [band_matrix(Y), band_matrix(Z)]
+    if fused_shell is not None:
+        xs_list, ys_list, zs_list = fused_shell
+        assert all(b.shape == (lo.x + hi.x, Y, Z) for b in xs_list)
+        assert all(b.shape == (X, lo.y + hi.y, Z) for b in ys_list)
+        assert all(b.shape == (X, lo.z + hi.z, Y) for b in zs_list)
+
+        def xidx(i):
+            # the x slab plane for shell positions; the long interior
+            # stretch clamps to slot 0 (a constant index — no refetch)
+            ip = jnp.minimum(i, X - 1)
+            return (
+                jnp.where(
+                    ip < lo.x,
+                    ip,
+                    jnp.where(ip >= X - hi.x, lo.x + ip - (X - hi.x), 0),
+                ),
+                0,
+                0,
+            )
+
+        in_specs += [pl.BlockSpec((1, Y, Z), xidx) for _ in range(nq)]
+        in_specs += [
+            pl.BlockSpec(
+                (1, lo.y + hi.y, Z), lambda i: (jnp.minimum(i, X - 1), 0, 0)
+            )
+            for _ in range(nq)
+        ]
+        in_specs += [
+            pl.BlockSpec(
+                (1, lo.z + hi.z, Y), lambda i: (jnp.minimum(i, X - 1), 0, 0)
+            )
+            for _ in range(nq)
+        ]
+        args += list(xs_list) + list(ys_list) + list(zs_list)
     out_specs = tuple(
         pl.BlockSpec((1, Y, Z), lambda i: (jnp.clip(i - r, 0, X - 1), 0, 0))
         for _ in range(nq)
@@ -355,13 +473,23 @@ def stream_wavefront_pass(
     # via the views' plane_nbr_sum (see stream_plane_pass)
     f32_accumulate: bool = False,  # bf16-storage variant: upcast at load,
     # f32 level rings + arithmetic, one downcast at the final store/emit
+    fused_shell=None,  # (xbufs, ybufs, zbufs) per quantity — the packed
+    # halo messages land in the level-0 planes in VMEM (halo="fused");
+    # mutually exclusive with z_slabs (the bespoke z-only scheme)
 ):
     """``m`` kernel levels in ONE pass over ``s_off``-shell-carrying shards —
     the user-kernel generalization of ``jacobi_shell_wavefront_step`` (see
     its docstring for the shrinking-validity contamination argument, the
     z-slab layout, and the lane-padding rationale; all carry over verbatim).
     Returns the advanced blocks, plus per-quantity outgoing z slabs when
-    ``z_slabs`` is given."""
+    ``z_slabs`` is given.
+
+    With ``fused_shell`` the blocks' shell cells are STALE and every axis's
+    fresh halos ride as side inputs (``fused_shell_exchange``): each
+    level-0 plane is patched in VMEM — x-shell planes replaced, then y
+    rows, then z columns (the exchange's sweep order) — so the level chain
+    sees exactly the planes an in-array exchange would have produced and
+    the pass output is bitwise-identical to the unfused form."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -369,6 +497,7 @@ def stream_wavefront_pass(
     Xr, Yr, Zr = raws[0].shape
     zv = Zr if z_valid is None else z_valid
     assert 1 <= m <= s_off and 2 * s_off < min(Xr, Yr, zv), (m, s_off, zv)
+    assert z_slabs is None or fused_shell is None
     gsize = global_size
     assert 2 * s_off < gsize.x, (s_off, gsize)  # non-negative lax.rem operand
     roll = _make_roll(interpret)
@@ -386,6 +515,11 @@ def stream_wavefront_pass(
             refs = refs[2:]
         else:
             bands = None
+        if fused_shell is not None:
+            xs_refs = refs[:nq]
+            ys_refs = refs[nq : 2 * nq]
+            zsf_refs = refs[2 * nq : 3 * nq]
+            refs = refs[3 * nq :]
         if z_slabs is not None:
             zs_refs = refs[:nq]
             out_refs = refs[nq : 2 * nq]
@@ -399,6 +533,18 @@ def stream_wavefront_pass(
         # level-0 raw plane i per quantity (upcast once under f32_accumulate)
         vals = [up(ref[0]) for ref in in_refs]
         y_g, z_g = _yz_coord_planes(origin_ref, Yr, Zr, s_off, s_off, gsize)
+        if fused_shell is not None:
+            # level-0 VMEM patch (module docstring; _fused_plane_patch —
+            # upcast once under f32_accumulate, like the raw planes)
+            s = s_off
+            t = jnp.where(
+                jnp.logical_or(i < s, i >= Xr - s), jnp.int32(Yr), jnp.int32(0)
+            )
+            for q in range(nq):
+                vals[q] = _fused_plane_patch(
+                    vals[q], up(xs_refs[q][0]), up(ys_refs[q][0]),
+                    up(zsf_refs[q][0]), t, s, s, s, s,
+                )
         if z_slabs is not None:
             # patch the z-shell columns in VMEM — never stored in the big
             # array (see jacobi_shell_wavefront_step)
@@ -462,6 +608,34 @@ def stream_wavefront_pass(
             pl.BlockSpec((Zr, Zr), lambda i: (0, 0)),
         ]
         args += [band_matrix(Yr), band_matrix(Zr)]
+    if fused_shell is not None:
+        xs_list, ys_list, zs_list = fused_shell
+        s = s_off
+        assert all(b.shape == (2 * s, Yr, Zr) for b in xs_list)
+        assert all(b.shape == (Xr, 2 * s, Zr) for b in ys_list)
+        assert all(b.shape == (Xr, 2 * s, Yr) for b in zs_list)
+
+        def xidx(i):
+            # x slab slot for shell planes; interior clamps to a constant
+            # slot 0 (no refetch over the long middle stretch)
+            return (
+                jnp.where(
+                    i < s, i, jnp.where(i >= Xr - s, s + i - (Xr - s), 0)
+                ),
+                0,
+                0,
+            )
+
+        in_specs += [pl.BlockSpec((1, Yr, Zr), xidx) for _ in range(nq)]
+        in_specs += [
+            pl.BlockSpec((1, 2 * s, Zr), lambda i: (i, 0, 0))
+            for _ in range(nq)
+        ]
+        in_specs += [
+            pl.BlockSpec((1, 2 * s, Yr), lambda i: (i, 0, 0))
+            for _ in range(nq)
+        ]
+        args += list(xs_list) + list(ys_list) + list(zs_list)
     if z_slabs is not None:
         for q in range(nq):
             assert z_slabs[q].shape == (Xr, 2 * s_off, Yr), z_slabs[q].shape
@@ -654,6 +828,10 @@ def _tuned_stream_plan(dd, x_radius: int, separable: bool) -> dict:
     # the static vpu, garbage invalidates the plan below
     if cfg.get("compute_unit") is not None:
         plan["compute_unit"] = cfg["compute_unit"]
+    # ...and so does the fused-halo axis: pre-halo entries lack the key and
+    # resolve to the static "array"; garbage invalidates to static
+    if cfg.get("halo") is not None:
+        plan["halo"] = cfg["halo"]
     n = dd.local_spec().sz
     shell = dd._shell_radius
     lo, hi = shell.lo(), shell.hi()
@@ -661,6 +839,8 @@ def _tuned_stream_plan(dd, x_radius: int, separable: bool) -> dict:
     ok = isinstance(m, int) and m >= 1
     if ok and plan.get("overlap") is not None:
         ok = plan["overlap"] in STREAM_OVERLAP
+    if ok and plan.get("halo") is not None:
+        ok = plan["halo"] in STREAM_HALO
     if ok and plan.get("compute_unit") is not None:
         ok = plan["compute_unit"] in COMPUTE_UNITS
     if ok and plan["grouping"] == "per-field":
@@ -1009,6 +1189,91 @@ def _resolve_stream_overlap(plan: dict) -> Tuple[str, str]:
     return val, source
 
 
+def fused_halo_ineligible(dd, plan: dict, exch_route: str) -> Optional[str]:
+    """Why ``halo="fused"`` cannot engage for this plan/domain/exchange
+    route — or None when it can.  The structural gates (module docstring):
+    the fused exchange packs at static offsets from even shards, patches
+    need blend-supported tile geometry, the split schedule's exterior
+    bands read exchanged BLOCKS, and only the plane / plain-wavefront
+    routes stream level-0 planes the buffers can land in."""
+    from stencil_tpu.ops import halo_blend
+    from stencil_tpu.ops.exchange import Y_PACK_ROUTES
+
+    if plan.get("route") not in ("plane", "wavefront"):
+        return f"the {plan.get('route')!r} route has no exchange to fuse"
+    if plan.get("z_slabs"):
+        return "the z-slab wavefront already keeps z halos out of the big array"
+    if plan.get("overlap") == "split":
+        return "the split schedule's exterior band passes read exchanged blocks"
+    if exch_route not in Y_PACK_ROUTES:
+        return (
+            f"the {exch_route!r} exchange route does not pack the y shell "
+            f"(fused needs one of {Y_PACK_ROUTES})"
+        )
+    if any(v is not None for v in dd._valid_last):
+        return "padded (uneven) shards — the fused pack cuts at static offsets"
+    if not all(halo_blend.supports(dd.field_dtype(h)) for h in dd._handles):
+        return "a field dtype without known tile geometry"
+    return None
+
+
+def _halo_request(plan: dict) -> Tuple[Optional[str], str]:
+    """Pre-structural (value, source) of a stream plan's halo consumption
+    mode.  Precedence mirrors the overlap axis: a FORCED plan value
+    (``halo_forced`` — explicit requests, autotuner candidate builds, the
+    ladder's fused→array step-down) > ``STENCIL_STREAM_HALO`` (validated
+    read) > the plan's tuned ``halo`` > the static ``array``."""
+    from stencil_tpu.utils.config import env_choice
+
+    val: Optional[str] = None
+    source = "static"
+    if plan.get("halo_forced") and plan.get("halo") is not None:
+        val, source = plan["halo"], "explicit"
+        if val not in STREAM_HALO:
+            raise ValueError(
+                f"unknown stream halo mode {val!r} (one of {STREAM_HALO})"
+            )
+    else:
+        env = env_choice("STENCIL_STREAM_HALO", "auto", ("auto",) + STREAM_HALO)
+        if env != "auto":
+            val, source = env, "env"
+        elif plan.get("halo") is not None:
+            tuned = plan["halo"]
+            if tuned in STREAM_HALO:
+                val, source = str(tuned), "tuned"
+            else:
+                from stencil_tpu.utils.logging import log_warn
+
+                log_warn(
+                    f"tuned stream halo {tuned!r} is not one of "
+                    f"{STREAM_HALO}; using the static 'array' fallback"
+                )
+    if val is None:
+        val = "array"
+    return val, source
+
+
+def _resolve_stream_halo(dd, plan: dict, exch_route: str) -> Tuple[str, str]:
+    """``_halo_request`` plus the structural guard: a ``fused`` the plan
+    cannot serve degrades to ``array`` with a warning (source tagged
+    ``/degraded``), never an error — a stale persisted config or a
+    cross-route env var must not kill a run ``array`` could have served.
+    (``make_stream_step`` re-plans a z-slab wavefront to the plain form
+    BEFORE this guard when fused was requested, like the split path.)"""
+    val, source = _halo_request(plan)
+    if val == "fused":
+        why = fused_halo_ineligible(dd, plan, exch_route)
+        if why is not None:
+            from stencil_tpu.utils.logging import log_warn
+
+            log_warn(
+                f"halo=fused ({source}) cannot engage here ({why}); "
+                "degrading to halo=array"
+            )
+            val, source = "array", source + "/degraded"
+    return val, source
+
+
 def plain_wavefront_plan(dd, plan: dict, max_depth: Optional[int] = None) -> Optional[dict]:
     """The PLAIN-form twin of a z-slab wavefront plan, at the deepest depth
     the VMEM model fits (the z-slab blocks leave the budget; the unpadded
@@ -1047,7 +1312,10 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True,
                        mxu_kernel=None):
     from jax.sharding import PartitionSpec as P
 
-    from stencil_tpu.ops.exchange import halo_exchange_multi
+    from stencil_tpu.ops.exchange import (
+        fused_shell_exchange,
+        halo_exchange_multi,
+    )
     from stencil_tpu.parallel.mesh import MESH_AXES
 
     names = [h.name for h in dd._handles]
@@ -1091,6 +1359,21 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True,
         m=plan["m"],
     )
     split = overlap == "split"
+    # fused unpack→blend axis (module docstring): resolved AFTER overlap —
+    # the split schedule structurally excludes fused — written back into
+    # the plan (the ladder and step._stream_plan read it) and recorded,
+    # the stream-engine twin of the exchange.route / step.overlap events
+    halo, halo_source = _resolve_stream_halo(dd, plan, exch_route)
+    plan["halo"] = halo
+    telemetry.emit_event(
+        tm.EVENT_STEP_HALO,
+        halo=halo,
+        source=halo_source,
+        route=plan["route"],
+        m=plan["m"],
+        exchange_route=exch_route,
+    )
+    fused = halo == "fused"
     # compute-unit axis (ops/jacobi_pallas COMPUTE_UNITS): shared precedence
     # chain (forced plan value = explicit requests / autotuner candidates /
     # ladder step-downs > STENCIL_COMPUTE_UNIT > tuned plan > static vpu)
@@ -1264,19 +1547,44 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True,
 
     elif plan["route"] == "plane":
 
-        def plane_groups(bs, origin):
+        def plane_groups(bs, origin, fused_bufs=None):
             out = list(bs)
             for g in groups:
+                fs = None
+                if fused_bufs is not None:
+                    xb, yb, zb = fused_bufs
+                    fs = (
+                        [xb[q] for q in g],
+                        [yb[q] for q in g],
+                        [zb[q] for q in g],
+                    )
                 outs = stream_plane_pass(
                     kernel, [names[q] for q in g], [bs[q] for q in g],
                     lo, hi, x_radius, origin, gsize, interpret=interpret,
+                    fused_shell=fs,
                     **unit_kw,
                 )
                 for q, o in zip(g, outs):
                     out[q] = o
             return out
 
-        if split:
+        if fused:
+
+            def per_shard(steps, *blocks):
+                def body(_, bs):
+                    origin = origin_of()
+                    bs = list(bs)
+                    # the packed messages never unpack into the blocks: the
+                    # received shell buffers ride into the pass and land in
+                    # the level-0 VMEM planes — no big-array halo write
+                    bufs = fused_shell_exchange(
+                        bs, shell, mesh_shape, route=exch_route
+                    )
+                    return tuple(plane_groups(bs, origin, bufs))
+
+                return lax.fori_loop(0, steps, body, tuple(blocks))
+
+        elif split:
 
             def narrow_plane(subs, ax, start, w, origin):
                 """One kernel level over ``3w``-wide face sub-blocks (``w ==
@@ -1347,11 +1655,19 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True,
         Zp = lane_pad_width(Zr) if z_slab_mode else Zr
         yext, xext = make_slab_extenders(Xr, Yr, s, mesh_shape)
 
-        def wavefront_groups(bs, depth, origin, zs=None):
+        def wavefront_groups(bs, depth, origin, zs=None, fused_bufs=None):
             """Run the m-level pass group by group; returns (outs, zouts)."""
             outs = list(bs)
             zouts = [None] * len(bs) if zs is not None else None
             for g in groups:
+                fs = None
+                if fused_bufs is not None:
+                    xb, yb, zb = fused_bufs
+                    fs = (
+                        [xb[q] for q in g],
+                        [yb[q] for q in g],
+                        [zb[q] for q in g],
+                    )
                 o, z = stream_wavefront_pass(
                     kernel, [names[q] for q in g], [bs[q] for q in g],
                     depth, s, origin, gsize,
@@ -1359,6 +1675,7 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True,
                     z_valid=Zr if zs is not None else None,
                     alias=alias,
                     interpret=interpret,
+                    fused_shell=fs,
                     **unit_kw,
                 )
                 for j, q in enumerate(g):
@@ -1393,7 +1710,24 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True,
         def per_shard(steps, *blocks):
             if not z_slab_mode:
 
-                if split:
+                if fused:
+
+                    def macro(depth, bs):
+                        origin = origin_of()
+                        bs = list(bs)
+                        # messages pack from the (stale-shell) blocks, the
+                        # received buffers corner-patch each other in the
+                        # sweep order, and the pass lands them in VMEM —
+                        # the big array never sees a halo write
+                        bufs = fused_shell_exchange(
+                            bs, shell, mesh_shape, route=exch_route
+                        )
+                        outs, _ = wavefront_groups(
+                            bs, depth, origin, fused_bufs=bufs
+                        )
+                        return tuple(outs)
+
+                elif split:
 
                     def macro(depth, bs):
                         origin = origin_of()
@@ -1489,6 +1823,7 @@ def make_stream_step(
     donate: bool = True,
     max_depth: int = None,
     overlap: str = "auto",
+    halo: str = "auto",
     compute_unit: str = "auto",
     mxu_kernel: PlaneKernel = None,
 ):
@@ -1519,6 +1854,17 @@ def make_stream_step(
     wavefront) degrades to ``off`` with a warning, and a compile-rejected
     split build steps down to ``off`` at the same depth through the ladder
     before any depth descent.
+
+    ``halo`` selects the fused unpack→blend mode (module docstring):
+    ``"auto"`` resolves ``STENCIL_STREAM_HALO`` > the tuned config > the
+    static ``"array"``; under ``"fused"`` the packed exchange messages
+    land directly in the pass's level-0 VMEM planes and the big array
+    never sees a halo write — bitwise-identical to ``"array"``.  A plan
+    it cannot serve (wrap, split schedule, non-``yzpack_*`` exchange
+    route, uneven shards) degrades to ``"array"`` with a warning; a
+    z-slab wavefront plan re-plans to the plain form first (like split);
+    a compile-rejected fused build steps down to ``"array"`` at the same
+    depth through the ladder before any depth descent.
 
     ``compute_unit`` selects the level kernels' execution unit (a tuner
     axis — docs/tuning.md "Compute unit and storage dtype"): ``"auto"``
@@ -1566,17 +1912,25 @@ def make_stream_step(
             f"unknown stream overlap {overlap!r} (one of "
             f"{('auto',) + STREAM_OVERLAP})"
         )
+    if halo not in ("auto",) + STREAM_HALO:
+        raise ValueError(
+            f"unknown stream halo mode {halo!r} (one of "
+            f"{('auto',) + STREAM_HALO})"
+        )
     if compute_unit not in ("auto",) + COMPUTE_UNITS:
         raise ValueError(
             f"unknown compute unit {compute_unit!r} (one of "
             f"{('auto',) + COMPUTE_UNITS})"
         )
     plan = plan_stream(dd, x_radius, path, separable, max_m=max_depth)
-    if overlap != "auto" or compute_unit != "auto":
+    if overlap != "auto" or halo != "auto" or compute_unit != "auto":
         plan = dict(plan)
     if overlap != "auto":
         plan["overlap"] = overlap
         plan["overlap_forced"] = True
+    if halo != "auto":
+        plan["halo"] = halo
+        plan["halo_forced"] = True
     if compute_unit != "auto":
         plan["compute_unit"] = compute_unit
         plan["compute_unit_forced"] = True
@@ -1585,7 +1939,11 @@ def make_stream_step(
     # big array for the exchange it overlaps, and the packed zpack_* routes
     # already de-amplified the thin-z traffic the slab form dodges.  When no
     # plain depth fits, the build's structural guard degrades split -> off.
-    if _overlap_request(plan)[0] == "split":
+    # The FUSED halo request re-plans the same way: the fused buffers are
+    # the level-0 patch of a plain pass, and the packed routes make the
+    # plain form's exchange cheap — when no plain depth fits, the build's
+    # structural guard degrades fused -> array.
+    if _overlap_request(plan)[0] == "split" or _halo_request(plan)[0] == "fused":
         plain = plain_wavefront_plan(dd, plan, max_depth=max_depth)
         if plain is not None:
             plan = plain
@@ -1614,6 +1972,8 @@ def make_stream_step(
         # build() resolves _build_stream_step through module globals at call
         # time, so tests may monkeypatch it
         suffix = ",split" if p.get("overlap") == "split" else ""
+        if p.get("halo") == "fused":
+            suffix += ",fused"
         if _prospective_unit(p) == "mxu":
             suffix += ",mxu"
         return Rung(
@@ -1649,6 +2009,20 @@ def make_stream_step(
             p2["compute_unit"] = "vpu"
             p2["compute_unit_forced"] = True
             return rung_for(p2)
+        if plan_now.get("halo") == "fused":
+            # next rung down: drop the fused halo mode at the SAME depth —
+            # the fused pass carries extra side-buffer blocks and per-plane
+            # patch selects, so a VMEM_OOM or compile reject may be the
+            # fused form's fault, not the depth's
+            log_warn(
+                f"halo=fused on {plan_now['route']}[m={plan_now['m']}] "
+                f"exceeded the compiler's capability ({cls.value}); stepping "
+                "down to halo=array at the same depth"
+            )
+            p2 = dict(plan_now)
+            p2["halo"] = "array"
+            p2["halo_forced"] = True
+            return rung_for(p2)
         if plan_now.get("overlap") == "split":
             # first rung down: drop the split schedule at the SAME depth —
             # the exterior passes carry their own scratch, so a VMEM_OOM or
@@ -1673,10 +2047,12 @@ def make_stream_step(
             "STENCIL_VMEM_LIMIT_BYTES)"
         )
         p2 = dict(plan_stream(dd, x_radius, path, separable, max_m=new_max))
-        # a descent never re-enables split or mxu: carry the (post-step-down)
-        # overlap/compute-unit state into the shallower plan as forced values
+        # a descent never re-enables split, fused, or mxu: carry the
+        # (post-step-down) axis state into the shallower plan as forced
         p2["overlap"] = plan_now.get("overlap", "off")
         p2["overlap_forced"] = True
+        p2["halo"] = plan_now.get("halo", "array")
+        p2["halo_forced"] = True
         p2["compute_unit"] = plan_now.get("compute_unit", "vpu")
         p2["compute_unit_forced"] = True
         return rung_for(p2)

@@ -79,9 +79,10 @@ def _entry(ts: float, key: str, value, unit: str, source: str, **extra) -> Optio
 
 def entries_from_artifact(path: str) -> List[dict]:
     """Normalize one artifact file (a ``BENCH_*.json`` bench result — raw
-    or judge-wrapped — or a ``weak_scaling_summary.json`` sweep) into
-    ledger entries.  Unknown shapes return [] rather than raising: the
-    ingest loop runs over globs."""
+    or judge-wrapped — a ``weak_scaling_summary.json`` sweep, or a
+    ``bench_exchange`` route-A/B JSON line saved to a file) into ledger
+    entries.  Unknown shapes return [] rather than raising: the ingest
+    loop runs over globs."""
     try:
         with open(path, encoding="utf-8") as f:
             doc = json.load(f)
@@ -122,6 +123,33 @@ def entries_from_artifact(path: str) -> List[dict]:
                     _entry(ts, f"weak:{mesh}:{ov}", val, "Mcells/s/chip",
                            source, chips=m.get("chips"))
                 )
+        return [e for e in out if e is not None]
+
+    if isinstance(doc, dict) and doc.get("bench") == "exchange":
+        # bench_exchange's route A/B (the packed-route wins): direct's
+        # steady-state rate plus every packed route's speedup-vs-direct —
+        # all higher-is-better, so the trailing-median gate catches a
+        # packed-route regression exactly like a headline drop
+        ab = doc.get("route_ab") or {}
+        direct = ((ab.get("routes") or {}).get("direct") or {}).get(
+            "ms_per_exchange"
+        )
+        if isinstance(direct, (int, float)) and direct > 0:
+            out.append(
+                _entry(
+                    ts,
+                    "exchange_ab:direct:exchanges_per_s",
+                    1e3 / direct,
+                    "1/s",
+                    source,
+                    extent=doc.get("extent"),
+                    quantities=doc.get("quantities"),
+                )
+            )
+        for route, sp in (ab.get("speedup_vs_direct") or {}).items():
+            out.append(
+                _entry(ts, f"exchange_ab:{route}:speedup", sp, "x", source)
+            )
         return [e for e in out if e is not None]
 
     return []

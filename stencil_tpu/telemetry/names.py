@@ -210,6 +210,10 @@ EVENT_EXCHANGE_ROUTE = "exchange.route"
 #: overlap=off|split, source=explicit|env|tuned|static|ladder or
 #: "<orig>/degraded" on a structural step-down, route, m)
 EVENT_STEP_OVERLAP = "step.overlap"
+#: a stream-engine step build resolved its halo consumption mode (fields:
+#: halo=array|fused, source=explicit|env|tuned|static|ladder or
+#: "<orig>/degraded" on a structural step-down, route, m, exchange_route)
+EVENT_STEP_HALO = "step.halo"
 #: a kernel build resolved its compute-unit axis (fields: unit=vpu|mxu,
 #: source=explicit|env|tuned|static|ladder or "<orig>/degraded" when a
 #: structural guard stepped an mxu request down, where)
@@ -248,6 +252,7 @@ ALL_EVENTS = frozenset({
     EVENT_TUNE_TRIAL,
     EVENT_EXCHANGE_ROUTE,
     EVENT_STEP_OVERLAP,
+    EVENT_STEP_HALO,
     EVENT_KERNEL_COMPUTE_UNIT,
     EVENT_KERNEL_STORAGE_DTYPE,
     EVENT_CHECKPOINT_SAVE,

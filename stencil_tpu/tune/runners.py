@@ -291,7 +291,7 @@ def autotune_stream(
     mxu_kernel=None,
 ) -> TuneReport:
     """Tune the generic stream engine's plan (route, depth, alias, overlap,
-    compute unit) for a REALIZED domain + user kernel.  Trials run
+    fused halo, compute unit) for a REALIZED domain + user kernel.  Trials run
     non-donating steps over the
     domain's live buffers (the domain state is never advanced), so the
     tuned plan feeds the very next ``make_step(engine="stream")`` on the
@@ -324,6 +324,9 @@ def autotune_stream(
             # same for the overlap A/B under STENCIL_STREAM_OVERLAP: the
             # off and split candidates must build their own schedules
             plan["overlap_forced"] = True
+        if "halo" in plan:
+            # and for the fused-halo A/B under STENCIL_STREAM_HALO
+            plan["halo_forced"] = True
         if "compute_unit" in plan:
             # and for the compute-unit A/B under STENCIL_COMPUTE_UNIT
             plan["compute_unit_forced"] = True
@@ -341,6 +344,7 @@ def autotune_stream(
     static = dict(static_plan)
     static.setdefault("halo_multiplier", static.get("m", 1))
     static.setdefault("overlap", "off")
+    static.setdefault("halo", "array")
     static.setdefault("compute_unit", "vpu")
     return tune.ensure(
         key,

@@ -17,11 +17,20 @@ Axes (ISSUE: the constants PERF_NOTES.md says to re-qualify per chip):
   collectives hide behind the VPU work at the cost of ~``6·3w``-wide band
   recomputes; ``off`` is the static fallback, and the win flips with the
   exchange/compute cost ratio — measured, not assumed.
-* **exchange route** (direct/zpack_xla/zpack_pallas) — the halo exchange's
-  z-sweep implementation: the sliced thin-z sliver vs the packed lane-major
-  z-shell message (ops/exchange.py EXCHANGE_ROUTES); ``direct`` is the
-  static fallback, the packed routes attack THE measured cost driver of
-  shell-carrying halo storage (PERF_NOTES "Thin z-region access").
+* **exchange route** (direct/zpack_xla/zpack_pallas/yzpack_xla/
+  yzpack_pallas) — the halo exchange's y/z-sweep implementation: sliced
+  thin slivers vs the packed lane-major z-shell message and, on the
+  ``yzpack_*`` routes, the packed sublane-major y-shell message too
+  (ops/exchange.py EXCHANGE_ROUTES); ``direct`` is the static fallback,
+  the packed routes attack the measured amplification of shell-carrying
+  halo storage (PERF_NOTES "Thin z-region access" / "Thin y-region
+  access").
+* **halo consumption** (array/fused) — the stream engine's fused
+  unpack→blend mode (ops/stream.py ``STREAM_HALO``): under ``fused`` the
+  packed ``yzpack_*`` messages land directly in the pass's level-0 VMEM
+  working planes and the big array never sees a halo write; ``array`` is
+  the static fallback — the win trades the saved unpack/blend dispatches
+  against per-plane patch selects, so it is measured, not assumed.
 * **compute unit** (vpu/mxu) — the level kernels' execution unit
   (ops/jacobi_pallas ``COMPUTE_UNITS``): the roll+add chain on the vector
   lanes vs one banded contraction per in-plane axis on the matrix unit —
@@ -215,28 +224,42 @@ def jacobi_wavefront_space(
 
 
 def exchange_space(dd) -> Tuple[List[dict], int]:
-    """(candidates, prefiltered) over the halo exchange's z-sweep route
+    """(candidates, prefiltered) over the halo exchange's y/z-sweep route
     (``ops/exchange.py`` EXCHANGE_ROUTES) for a REALIZED domain: ``direct``
     (the static fallback — the thin-z sliver path, ~64×-amplified on the
-    (8,128) tiling, PERF_NOTES "Thin z-region access") vs the two packed
+    (8,128) tiling, PERF_NOTES "Thin z-region access"; the y sliver is
+    sublane-amplified ~8/(2r), "Thin y-region access") vs the packed
     z-shell routes (``zpack_xla`` / ``zpack_pallas``: lane-major ``(2m, Y,
-    Xpad)`` message buffers).  Packed candidates that structurally cannot
-    engage (uneven z split, unsupported dtype, no z shell at all) are
-    prefiltered — they count into ``tune.pruned`` without burning a trial."""
-    from stencil_tpu.ops.exchange import EXCHANGE_ROUTES, zpack_supported
+    Xpad)`` messages) and the y+z packed routes (``yzpack_xla`` /
+    ``yzpack_pallas``: additionally the sublane-major ``(2m, X, Z)`` y
+    message).  Candidates that structurally cannot engage are prefiltered —
+    they count into ``tune.pruned`` without burning a trial.  A ``zpack_*``
+    candidate needs the z sweep; a ``yzpack_*`` candidate needs the Y sweep
+    (with y ineligible it would compile and measure a byte-identical
+    duplicate of its ``zpack_*`` sibling)."""
+    from stencil_tpu.ops.exchange import (
+        EXCHANGE_ROUTES,
+        Y_PACK_ROUTES,
+        ypack_supported,
+        zpack_supported,
+    )
 
     cands: List[dict] = [{"exchange_route": "direct"}]
     shell = dd._shell_radius
-    packed_ok = (
+    dtypes = [dd.field_dtype(h) for h in dd._handles]
+    z_ok = (
         shell is not None
         and (shell.axis(2, -1) > 0 or shell.axis(2, +1) > 0)
-        and zpack_supported(
-            [dd.field_dtype(h) for h in dd._handles], dd._valid_last
-        )
+        and zpack_supported(dtypes, dd._valid_last)
+    )
+    y_ok = (
+        shell is not None
+        and (shell.axis(1, -1) > 0 or shell.axis(1, +1) > 0)
+        and ypack_supported(dtypes, dd._valid_last)
     )
     prefiltered = 0
     for route in EXCHANGE_ROUTES[1:]:
-        if packed_ok:
+        if y_ok if route in Y_PACK_ROUTES else z_ok:
             cands.append({"exchange_route": route})
         else:
             prefiltered += 1
@@ -255,24 +278,34 @@ def stream_space(dd, x_radius: int, separable: bool, static_plan: dict,
     Every candidate is a plan dict ``_build_stream_step`` accepts verbatim
     (+ ``alias``/``overlap``/``compute_unit``).
 
-    Every candidate carries explicit ``overlap`` and ``compute_unit``
-    fields ("off"/"vpu" unless it IS that axis's twin) so persisted winners
-    record the axes — while older entries WITHOUT the fields stay
-    consultable (absent = the static off/vpu, ops/stream.py
-    ``_overlap_request`` / the compute-unit resolver); no cache schema
+    Every candidate carries explicit ``overlap``, ``halo``, and
+    ``compute_unit`` fields ("off"/"array"/"vpu" unless it IS that axis's
+    twin) so persisted winners record the axes — while older entries
+    WITHOUT the fields stay consultable (absent = the static
+    off/array/vpu, ops/stream.py ``_overlap_request`` /
+    ``_halo_request`` / the compute-unit resolver); no cache schema
     bump.  The split twin of a z-slab wavefront re-plans to the plain form
     (``plain_wavefront_plan``): split needs z halos in the big array for
-    the exchange it overlaps."""
-    from stencil_tpu.ops.stream import plain_wavefront_plan, plan_stream
+    the exchange it overlaps.  The fused-halo twin (``halo="fused"`` —
+    the packed messages land in the pass's level-0 VMEM planes,
+    docs/tuning.md "Fused halo consumption") re-plans the same way and is
+    structurally prefiltered unless the domain's resolved exchange route
+    packs the y shell (``fused_halo_ineligible``)."""
+    from stencil_tpu.ops.stream import (
+        fused_halo_ineligible,
+        plain_wavefront_plan,
+        plan_stream,
+    )
 
     cands: List[dict] = []
 
     def add(plan: dict, alias: Optional[bool], overlap: str = "off",
-            unit: str = "vpu") -> None:
+            unit: str = "vpu", halo: str = "array") -> None:
         c = dict(plan)
         if alias is not None:
             c["alias"] = alias
         c["overlap"] = overlap
+        c["halo"] = halo
         c["compute_unit"] = unit
         c.setdefault("halo_multiplier", c.get("m", 1))
         if c not in cands:
@@ -312,13 +345,34 @@ def stream_space(dd, x_radius: int, separable: bool, static_plan: dict,
             split_bases.append((c, c.get("alias")))
             break
     for base, alias_pick in split_bases:
-        b = {k: v for k, v in base.items() if k not in ("overlap", "halo_multiplier")}
+        b = {k: v for k, v in base.items()
+             if k not in ("overlap", "halo", "halo_multiplier")}
         add(b, alias_pick, overlap="split")
+    prefiltered = 0
+    # the fused-halo A/B: a fused twin of the static plan (plain-form
+    # re-plan when the static pick is a z-slab wavefront, like split),
+    # measured against its array sibling — prefiltered when the fused mode
+    # structurally cannot engage (non-yzpack exchange route, uneven
+    # shards, wrap route, unsupported dtype)
+    fused_base = None
+    if static_plan["route"] in ("plane", "wavefront"):
+        fused_base = static_plan
+        if static_plan.get("z_slabs"):
+            fused_base = plain_wavefront_plan(dd, static_plan)
+    if fused_base is not None and fused_halo_ineligible(
+        dd,
+        dict(fused_base, overlap="off", z_slabs=fused_base.get("z_slabs", False)),
+        getattr(dd, "_exchange_route", "direct"),
+    ) is None:
+        b = {k: v for k, v in fused_base.items()
+             if k not in ("overlap", "halo", "halo_multiplier")}
+        add(b, static_alias, halo="fused")
+    else:
+        prefiltered += 1
     # the compute-unit A/B: an mxu twin of the static plan, measured against
     # its vpu sibling under the same protocol (the "Break the VPU wall"
     # lever — the win depends on where the plan sits relative to the
     # roll+add wall, so it is measured, not assumed)
-    prefiltered = 0
     if mxu_ok:
         b = {
             k: v
@@ -345,6 +399,7 @@ def stream_space(dd, x_radius: int, separable: bool, static_plan: dict,
             all(c.get(k) == v for k, v in static_plan.items()
                 if k not in ("halo_multiplier", "alias"))
             and c.get("overlap", "off") == "off"
+            and c.get("halo", "array") == "array"
             and c.get("compute_unit", "vpu") == "vpu"
         )
         if not is_static and check_vmem(dd, c) is not None:

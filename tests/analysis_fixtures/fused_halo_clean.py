@@ -1,0 +1,47 @@
+# analysis-fixture: contract=fused-halo expect=clean
+"""The sanctioned fused shape: the shell buffers ride into the pass as
+side inputs and the kernel patches its VMEM plane — the big array is only
+ever written whole by the pass output, never through a halo window or a
+blend/unpack kernel."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+
+def _fused_pass_kernel(blk_ref, xs_ref, ys_ref, zs_ref, o_ref):
+    v = blk_ref[...]
+    # level-0 VMEM patch: planes/rows/columns selected from the buffers
+    planes = jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+    v = jnp.where(planes == 0, xs_ref[0][None, :, :], v)
+    rows = jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+    v = jnp.where(rows == 0, ys_ref[:, 0, :][:, None, :], v)
+    cols = jax.lax.broadcasted_iota(jnp.int32, v.shape, 2)
+    v = jnp.where(cols == 0, zs_ref[:, 0, :][:, :, None], v)
+    o_ref[...] = v
+
+
+def build():
+    def step(block, xs, ys, zs):
+        return pl.pallas_call(
+            _fused_pass_kernel,
+            out_shape=jax.ShapeDtypeStruct((16, 16, 16), jnp.float32),
+            interpret=True,
+        )(block, xs, ys, zs)
+
+    block = jax.ShapeDtypeStruct((16, 16, 16), jnp.float32)
+    xs = jax.ShapeDtypeStruct((4, 16, 16), jnp.float32)
+    ys = jax.ShapeDtypeStruct((16, 4, 16), jnp.float32)
+    zs = jax.ShapeDtypeStruct((16, 4, 16), jnp.float32)
+    return analysis.trace_artifact(
+        step,
+        block,
+        xs,
+        ys,
+        zs,
+        label="fixture:fused-halo-clean",
+        kind="fn",
+        axes={"halo": "fused"},
+    )

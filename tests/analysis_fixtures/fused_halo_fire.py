@@ -1,0 +1,28 @@
+# analysis-fixture: contract=fused-halo expect=fire
+"""A program CLAIMING the fused halo mode while still blending a received
+slab into the big array with a partial-window update — exactly the
+big-array halo write ``halo="fused"`` exists to eliminate."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from stencil_tpu import analysis
+
+
+def build():
+    def step(block, slab):
+        # a thin y-window write on the raw-shaped array: the unfused
+        # exchange's unpack, smuggled into a program whose axes claim fused
+        return lax.dynamic_update_slice(block, slab, (0, 0, 0))
+
+    block = jax.ShapeDtypeStruct((16, 16, 16), jnp.float32)
+    slab = jax.ShapeDtypeStruct((16, 2, 16), jnp.float32)
+    return analysis.trace_artifact(
+        step,
+        block,
+        slab,
+        label="fixture:fused-halo-fire",
+        kind="fn",
+        axes={"halo": "fused"},
+    )

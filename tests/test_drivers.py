@@ -176,8 +176,20 @@ def test_bench_exchange(capsys):
         assert entry["ms_per_exchange"] > 0
         assert set(entry["per_axis_ms"]) == {"x", "y", "z"}
     if ab["packed_eligible"]:
-        assert set(ab["routes"]) == {"direct", "zpack_xla", "zpack_pallas"}
-        assert set(ab["speedup_vs_direct"]) == {"zpack_xla", "zpack_pallas"}
+        packed = {
+            "zpack_xla", "zpack_pallas", "yzpack_xla", "yzpack_pallas",
+        }
+        assert set(ab["routes"]) == {"direct"} | packed
+        assert set(ab["speedup_vs_direct"]) == packed
+        # shared-leg provenance: only the legs a route does NOT change may
+        # be shared from direct — x everywhere, y only on the z-only routes
+        shared = ab["measurement_protocol"]["shared_legs_with_direct"]
+        assert shared == {
+            "zpack_xla": ["x", "y"],
+            "zpack_pallas": ["x", "y"],
+            "yzpack_xla": ["x"],
+            "yzpack_pallas": ["x"],
+        }
 
 
 # stencil-lint: disable=slow-marker imports bench.py as a module and calls one tiny in-process interpret-mode A/B (~3 s measured); nothing is spawned

@@ -17,7 +17,13 @@ import pytest
 from stencil_tpu import telemetry, tune
 from stencil_tpu.core.radius import Radius
 from stencil_tpu.domain import DistributedDomain
-from stencil_tpu.ops.exchange import EXCHANGE_ROUTES, zpack_supported
+from stencil_tpu.ops.exchange import (
+    EXCHANGE_ROUTES,
+    Y_PACK_ROUTES,
+    route_supported,
+    ypack_supported,
+    zpack_supported,
+)
 from stencil_tpu.resilience import inject
 from stencil_tpu.telemetry import names as tm
 from stencil_tpu.tune import space as tune_space
@@ -43,13 +49,16 @@ def tune_dir(tmp_path, monkeypatch):
     tune.reset_memo()
 
 
-def _build(route=None, size=(16, 16, 16), radius=2, dtypes=(jnp.float32,), mult=1):
+def _build(route=None, size=(16, 16, 16), radius=2, dtypes=(jnp.float32,), mult=1,
+           storage=None):
     dd = DistributedDomain(*size)
     dd.set_radius(radius if isinstance(radius, Radius) else Radius.constant(radius))
     if route is not None:
         dd.set_exchange_route(route)
     if mult > 1:
         dd.set_halo_multiplier(mult)
+    if storage is not None:
+        dd.set_storage(storage)
     hs = [dd.add_data(f"q{i}", dtype=t) for i, t in enumerate(dtypes)]
     dd.realize()
     for i, h in enumerate(hs):
@@ -94,8 +103,26 @@ def test_packed_bitwise_multi_quantity_fused():
 
 
 def test_packed_bitwise_uneven_xy_shards():
-    """Packed z engages while x/y run the dynamic-offset direct path."""
+    """Packed z engages while x/y run the dynamic-offset direct path (the
+    yzpack routes degrade their y sweep here — each sweep independently)."""
     _assert_routes_bitwise(size=(17, 15, 16), radius=1)
+
+
+def test_packed_bitwise_uneven_z_shard():
+    """The mirror case: the yzpack routes pack their y sweep while z runs
+    the dynamic-offset direct path — partial engagement stays bitwise."""
+    _assert_routes_bitwise(size=(16, 16, 17), radius=1)
+
+
+def test_bf16_storage_ypack_bitwise():
+    """bf16 STORAGE rides the y pack's (16,128) tile geometry: the
+    sublane-major y message at 2 B/cell comes back bit-exact."""
+    kw = dict(radius=1, storage="bf16")
+    _, want = _exchanged_raws("direct", **kw)
+    for route in ("yzpack_xla", "yzpack_pallas"):
+        _, got = _exchanged_raws(route, **kw)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
 
 
 def test_packed_bitwise_halo_multiplier_shell():
@@ -196,15 +223,35 @@ def test_zpack_supported_gates():
     assert not zpack_supported([jnp.complex128], (None, None, None))
 
 
+def test_ypack_supported_gates():
+    assert ypack_supported([jnp.float32, jnp.int8], (None, None, None))
+    assert not ypack_supported([jnp.float32], (None, 7, None))  # padded y
+    assert ypack_supported([jnp.float32], (None, None, 7))  # padded z is fine
+    assert not ypack_supported([jnp.complex128], (None, None, None))
+
+
+def test_route_supported_composes_sweeps():
+    """A yzpack route is supported when EITHER packed sweep can engage; the
+    z-only routes need the z sweep; direct always."""
+    f32 = [jnp.float32]
+    assert route_supported("direct", f32, (None, 7, 7))
+    assert route_supported("zpack_xla", f32, (None, None, None))
+    assert not route_supported("zpack_xla", f32, (None, None, 7))
+    assert route_supported("yzpack_xla", f32, (None, None, 7))  # y carries it
+    assert route_supported("yzpack_pallas", f32, (None, 7, None))  # z carries it
+    assert not route_supported("yzpack_xla", f32, (None, 7, 7))
+
+
 # --- resilience --------------------------------------------------------------
 
 
-def test_compile_reject_steps_down_to_direct(tune_dir):
+@pytest.mark.parametrize("route", ["zpack_pallas", "yzpack_pallas"])
+def test_compile_reject_steps_down_to_direct(tune_dir, route):
     """A packed route the compiler rejects descends the ladder to direct at
     realize — counted, event-logged, and the run proceeds."""
     before = telemetry.snapshot()["counters"][tm.LADDER_DESCENTS]
-    inject.set_plan("compile:compile_reject:exchange:zpack_pallas")
-    dd, hs = _build("zpack_pallas", radius=1)
+    inject.set_plan(f"compile:compile_reject:exchange:{route}")
+    dd, hs = _build(route, radius=1)
     assert dd.exchange_route() == "direct"
     assert telemetry.snapshot()["counters"][tm.LADDER_DESCENTS] == before + 1
     dd.exchange()  # the stepped-down exchange is live
@@ -235,8 +282,23 @@ def test_exchange_space_prefilters_ineligible():
     assert cands[0] == {"exchange_route": "direct"}
     assert {c["exchange_route"] for c in cands} == set(EXCHANGE_ROUTES)
     assert pre == 0
+    # uneven z: the z-only packed routes prefilter, but the yzpack routes
+    # stay candidates (their y sweep engages — a distinct program)
     dd_uneven, _ = _build(size=(16, 16, 17), radius=1)
     cands, pre = tune_space.exchange_space(dd_uneven)
+    assert {c["exchange_route"] for c in cands} == {"direct", *Y_PACK_ROUTES}
+    assert pre == 2
+    # uneven y with even z: the yzpack candidates would measure
+    # byte-identical duplicates of their zpack siblings — prefiltered
+    dd_uy, _ = _build(size=(16, 15, 16), radius=1)
+    cands, pre = tune_space.exchange_space(dd_uy)
+    assert {c["exchange_route"] for c in cands} == {
+        "direct", "zpack_xla", "zpack_pallas",
+    }
+    assert pre == 2
+    # both packed axes uneven: nothing can engage
+    dd_both, _ = _build(size=(16, 15, 17), radius=1)
+    cands, pre = tune_space.exchange_space(dd_both)
     assert cands == [{"exchange_route": "direct"}]
     assert pre == len(PACKED_ROUTES)
 
@@ -307,3 +369,47 @@ def test_packed_counters_and_route_event(tmp_path):
     dd, _ = _build("direct", radius=2)
     dd.exchange()
     assert telemetry.snapshot()["counters"][tm.EXCHANGE_PACKED_BYTES] == c0
+
+
+def test_ypack_counters_add_y_messages():
+    """The yzpack routes' analytic packed traffic = the zpack model PLUS
+    the sublane-major y messages (depth * X * Z per quantity slice per
+    direction, no explicit pad) — per engaged sweep."""
+    from stencil_tpu.ops.exchange import ypack_message_stats
+
+    def delta(route):
+        before = telemetry.snapshot()["counters"]
+        dd, _ = _build(route, radius=2)
+        dd.exchange()
+        after = telemetry.snapshot()["counters"]
+        raw = dd.local_spec().raw_size()
+        return (
+            after[tm.EXCHANGE_PACKED_BYTES] - before[tm.EXCHANGE_PACKED_BYTES],
+            after[tm.EXCHANGE_PACKED_KERNELS]
+            - before[tm.EXCHANGE_PACKED_KERNELS],
+            raw,
+            dd.num_subdomains(),
+        )
+
+    zb, zk, raw, n_doms = delta("zpack_pallas")
+    yb, yk, _, _ = delta("yzpack_pallas")
+    nb, nk = ypack_message_stats((raw.x, raw.y, raw.z), 2, 2, [4])
+    assert yb - zb == nb * n_doms
+    assert yk - zk == nk * n_doms
+
+
+def test_pre_ypack_cache_entry_stays_warm(tune_dir):
+    """The route vocabulary grew with NO schema bump: an entry persisted
+    before the y routes existed (a zpack winner) is still consulted, and a
+    persisted yzpack winner resolves on the next realize."""
+    probe = DistributedDomain(16, 16, 16)
+    probe.set_radius(Radius.constant(2))
+    probe.add_data("q0")
+    key = probe.tune_key("exchange")
+    tune.record_config(key, {"exchange_route": "zpack_pallas"})  # pre-ypack era
+    dd, _ = _build()
+    assert dd.exchange_route() == "zpack_pallas"
+    tune.record_config(key, {"exchange_route": "yzpack_pallas"})
+    tune.reset_memo()
+    dd, _ = _build()
+    assert dd.exchange_route() == "yzpack_pallas"

@@ -99,6 +99,38 @@ class TestIngest:
             "weak:2x2x2:off": 90.0,  # the None cell is dropped, not 0
         }
 
+    def test_bench_exchange_route_ab(self, tmp_path):
+        """bench_exchange's route-A/B JSON line lands as its own series:
+        direct's steady-state rate plus each packed route's speedup — all
+        higher-is-better, so packed-route wins are regression-gated like
+        the headline numbers."""
+        doc = {
+            "bench": "exchange",
+            "extent": [128, 128, 128],
+            "quantities": 1,
+            "route_ab": {
+                "routes": {
+                    "direct": {"ms_per_exchange": 2.0, "per_axis_ms": {}},
+                    "zpack_xla": {"ms_per_exchange": 1.0, "per_axis_ms": {}},
+                    "yzpack_xla": {"ms_per_exchange": 0.8, "per_axis_ms": {}},
+                },
+                "speedup_vs_direct": {
+                    "zpack_xla": 2.0, "yzpack_xla": 2.5, "broken": None,
+                },
+            },
+        }
+        p = tmp_path / "exchange_ab.json"
+        p.write_text(json.dumps(doc))
+        entries = ledger.entries_from_artifact(str(p))
+        keys = {e["key"]: e["value"] for e in entries}
+        assert keys == {
+            "exchange_ab:direct:exchanges_per_s": 500.0,
+            "exchange_ab:zpack_xla:speedup": 2.0,
+            "exchange_ab:yzpack_xla:speedup": 2.5,  # None speedup dropped
+        }
+        # and the gate consumes them like any other series
+        assert ledger.append_entries(str(tmp_path / "l.jsonl"), entries) == 3
+
     def test_unknown_shapes_are_skipped(self, tmp_path):
         p = tmp_path / "x.json"
         p.write_text(json.dumps({"something": "else"}))

@@ -422,7 +422,7 @@ class TestLadderEngines:
         step = dd.make_step(mean6_kernel, engine="stream", interpret=True)
         assert step._stream_plan == {
             "route": "wavefront", "m": 3, "z_slabs": True, "grouping": "joint",
-            "overlap": "off", "compute_unit": "vpu",
+            "overlap": "off", "halo": "array", "compute_unit": "vpu",
         }
         inject.set_plan("execute:vmem_oom:stream*2")
         dd.run_step(step, 4)

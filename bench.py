@@ -100,12 +100,16 @@ def measured_copy_gbps(rt: float, n: int = 514, steps: int = 50) -> float:
 def mxu_vs_vpu_ab(size: int, k: int, interpret: bool, rt: float,
                   reps: int = 3, inner: int = None) -> dict:
     """Steady-state compute-unit A/B on the headline wrap workload: the
-    SAME k-level kernel under ``vpu`` (roll+add chain) and ``mxu`` (banded
-    contraction, ops/jacobi_pallas ``band_matrix``), alternating in ONE
-    process under the trial protocol (rep-0 drop, steady-state median) —
-    the ``route_ab`` shape from the exchange bench, applied to the "Break
-    the VPU wall" lever so the win/loss lands in the BENCH artifact next
-    to the headline it would move.  Returns the JSON section."""
+    SAME k-level kernel under ``vpu`` (roll+add chain), ``mxu`` (dense
+    banded contraction, ops/jacobi_pallas ``band_matrix``), ``mxu_band``
+    (the blocked (2r+1)-band tiling), and the band variant's bf16-INPUT
+    leg (``mxu_band+bf16in`` — the doubled-ratio arm of the "VPU wall"
+    break-even model), alternating in ONE process under the trial protocol
+    (rep-0 drop, steady-state median) — the ``route_ab`` shape from the
+    exchange bench, applied to the "Break the VPU wall" lever so the
+    win/loss lands in the BENCH artifact next to the headline it would
+    move.  ``scripts/perf_ledger.py`` ingests every leg as a
+    regression-gated ``mxu_ab:*`` series.  Returns the JSON section."""
     import statistics as _stats
     from functools import partial
 
@@ -113,29 +117,43 @@ def mxu_vs_vpu_ab(size: int, k: int, interpret: bool, rt: float,
     import jax.numpy as jnp
     from jax import lax
 
-    from stencil_tpu.ops.jacobi_pallas import jacobi_wrap_step, mxu_supported
+    from stencil_tpu.ops.jacobi_pallas import (
+        band_tile_plan,
+        jacobi_wrap_step,
+        mxu_supported,
+    )
     from stencil_tpu.tune.trial import measure_alternating
 
     cells = float(size) ** 3
+    eligible = bool(mxu_supported([jnp.float32]))
+    band_ok = eligible and band_tile_plan(size, size) is not None
     section = {
-        "eligible": bool(mxu_supported([jnp.float32])),
+        "eligible": eligible,
+        "band_eligible": band_ok,
         "k": k,
         "measurement_protocol": {
             "alternating": True, "drop_rep0": True, "stat": "median",
         },
         "units": {},
         "speedup_vs_vpu": None,
+        "speedups_vs_vpu": {},
     }
-    units = ["vpu"] + (["mxu"] if section["eligible"] else [])
+    legs = [("vpu", "vpu", "f32")]
+    if eligible:
+        legs.append(("mxu", "mxu", "f32"))
+    if band_ok:
+        legs.append(("mxu_band", "mxu_band", "f32"))
+        legs.append(("mxu_band+bf16in", "mxu_band", "bf16"))
     block = jnp.full((size, size, size), 0.5, jnp.float32)
 
-    def make_run(unit):
+    def make_run(unit, mxu_input):
         @partial(jax.jit, static_argnums=1)
         def steps(b, n):
             return lax.fori_loop(
                 0, n,
                 lambda _, bb: jacobi_wrap_step(
-                    bb, interpret=interpret, k=k, compute_unit=unit
+                    bb, interpret=interpret, k=k, compute_unit=unit,
+                    mxu_input=mxu_input,
                 ),
                 b,
             )
@@ -147,23 +165,27 @@ def mxu_vs_vpu_ab(size: int, k: int, interpret: bool, rt: float,
 
     if inner is None:
         inner = 25 if size >= 256 else 2
-    runs = [make_run(u) for u in units]
+    runs = [make_run(unit, mi) for _, unit, mi in legs]
     inners = [inner] * len(runs)
     for run, n in zip(runs, inners):
         run(n)  # warm + compile at the timed count
     rounds = measure_alternating(runs, inners, rt, reps)
-    for unit, per_rep in zip(units, rounds):
+    for (key, _, _), per_rep in zip(legs, rounds):
         dt = _stats.median(per_rep)  # seconds per k-level dispatch
-        section["units"][unit] = {
+        section["units"][key] = {
             "ms_per_dispatch": round(dt * 1e3, 3),
             "mcells_per_s": round(cells * k / dt / 1e6, 1),
         }
-    if "mxu" in section["units"]:
-        section["speedup_vs_vpu"] = round(
-            section["units"]["vpu"]["ms_per_dispatch"]
-            / max(section["units"]["mxu"]["ms_per_dispatch"], 1e-12),
-            3,
-        )
+    vpu_ms = section["units"]["vpu"]["ms_per_dispatch"]
+    for key in section["units"]:
+        if key != "vpu":
+            section["speedups_vs_vpu"][key] = round(
+                vpu_ms
+                / max(section["units"][key]["ms_per_dispatch"], 1e-12),
+                3,
+            )
+    # legacy scalar (pre-band artifacts carried only the dense ratio)
+    section["speedup_vs_vpu"] = section["speedups_vs_vpu"].get("mxu")
     return section
 
 

@@ -1,0 +1,100 @@
+"""Probe: band-tiled vs dense MXU contraction vs the VPU roll chain, per
+radius and plane extent — the calibration sweep behind PERF_NOTES "VPU
+wall (band-tiled re-derivation)".
+
+Times the bare in-plane (2r+1)-band neighbor sum (the per-level work the
+compute-unit axis moves between units) as a jitted X-deep batch over
+(n, n) planes, outside pallas: this isolates the CONTRACTION cost the
+break-even model prices (``3·(2r+1)·pad`` FLOPs per vpu op for the band
+form vs ``2·n`` dense), without the plane pipeline's DMA share.  Four
+variants per (r, n) point:
+
+* ``vpu``        — the roll+add chain (2r rolls + adds per axis)
+* ``mxu``        — the dense circulant contraction (band_matrix)
+* ``band``       — the blocked band tiling (band_wide_tile / mxu_band)
+* ``band+bf16``  — the band form with bfloat16 inputs (f32 accumulate)
+
+Alternating best-of-reps like the other probes (contention hits every
+variant equally).  ``python probe_mxu_band.py [reps]`` — sweeps
+r ∈ {1, 2, 4} × n ∈ {256, 384, 512}; on CPU containers the numbers are
+interpreter noise, run on a chip for the PERF_NOTES record.
+"""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.ops.jacobi_pallas import (
+    band_matrix,
+    band_tile_plan,
+    band_wide_tile,
+    make_plane_nbr_sum,
+)
+
+RADII = (1, 2, 4)
+EXTENTS = (256, 384, 512)
+DEPTH = 16  # planes per timed dispatch (amortizes dispatch overhead)
+
+
+def build(variant, n, r):
+    """jitted run(planes) -> planes applying the (2r+1)-band neighbor sum
+    once per plane, per variant."""
+    if variant == "vpu":
+
+        @jax.jit
+        def apply(planes):
+            out = jnp.zeros_like(planes)
+            for off in range(1, r + 1):
+                out = (
+                    out
+                    + jnp.roll(planes, off, 1) + jnp.roll(planes, -off, 1)
+                    + jnp.roll(planes, off, 2) + jnp.roll(planes, -off, 2)
+                )
+            return out
+
+        return apply
+    mxu_input = "bf16" if variant == "band+bf16" else "f32"
+    unit = "mxu" if variant == "mxu" else "mxu_band"
+    dt = jnp.bfloat16 if mxu_input == "bf16" else jnp.float32
+    if unit == "mxu":
+        b1, b2 = band_matrix(n, dt, r), band_matrix(n, dt, r)
+    else:
+        gy, gz = band_tile_plan(n, n, r)
+        b1 = band_wide_tile(gy, r, dt)
+        b2 = jnp.transpose(band_wide_tile(gz, r, dt))
+    nbr = make_plane_nbr_sum(n, n, unit, mxu_input, r)
+
+    @jax.jit
+    def apply(planes):
+        return jax.vmap(lambda p: nbr(p, b1, b2))(planes)
+
+    return apply
+
+
+def main():
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+    for r in RADII:
+        for n in EXTENTS:
+            if band_tile_plan(n, n, r) is None:
+                print(f"r={r} n={n}: no band tiling (dense only)", flush=True)
+                continue
+            planes = jnp.full((DEPTH, n, n), 0.5, jnp.float32)
+            variants = ("vpu", "mxu", "band", "band+bf16")
+            runs = {v: build(v, n, r) for v in variants}
+            for v in variants:  # warm + compile
+                runs[v](planes).block_until_ready()
+            best = {v: float("inf") for v in variants}
+            for _ in range(reps):
+                for v in variants:  # alternating: contention hits all
+                    t0 = time.perf_counter()
+                    runs[v](planes).block_until_ready()
+                    best[v] = min(best[v], time.perf_counter() - t0)
+            cells = DEPTH * n * n
+            rates = {v: f"{cells / best[v] / 1e9:.2f}" for v in variants}
+            print(f"r={r} n={n} Gcells/s {rates}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,7 @@ class ProgramSpec:
     overlap: str = "off"
     halo: str = "array"
     compute_unit: str = "vpu"
+    mxu_input: str = "f32"
     storage_dtype: str = "native"
 
     @property
@@ -84,6 +85,7 @@ class ProgramSpec:
             "halo": self.halo,
             "exchange_route": self.exchange_route,
             "compute_unit": self.compute_unit,
+            "mxu_input": self.mxu_input,
             "storage_dtype": self.storage_dtype,
         }
 
@@ -98,7 +100,12 @@ class ProgramSpec:
 CANONICAL_PROGRAMS: List[ProgramSpec] = [
     ProgramSpec("step:wrap/off", n_devices=1),
     ProgramSpec("step:plane/off/direct", stream_path="plane"),
-    ProgramSpec("step:plane/split/direct", stream_path="plane", overlap="split"),
+    # (The former plane/split program was deduped when the mxu_band entry
+    # landed: both wavefront/split programs exercise every split-schedule
+    # contract clause — interior independence, exterior taint, band-blend
+    # sliver hygiene — and the plane route stays covered at overlap=off by
+    # two programs; no contract discriminates plane×split from
+    # wavefront×split, so the build-time budget goes to the new axis.)
     ProgramSpec(
         "step:plane/off/zpack_pallas",
         stream_path="plane",
@@ -122,6 +129,18 @@ CANONICAL_PROGRAMS: List[ProgramSpec] = [
         halo_mult=2,
         overlap="split",
         compute_unit="mxu",
+    ),
+    # the band-tiled contraction variant with bf16 MXU inputs: one program
+    # covers both new axis values (the accum-dtype contract verifies every
+    # bf16-operand dot_general still pins the f32 accumulator, and the
+    # vmem-budget contract prices the band tiles instead of the dense
+    # circulants).  16³ at mult 2 shards to 12-wide raw planes — band
+    # granule 3 — so the traced program really runs the blocked form.
+    ProgramSpec(
+        "step:wavefront/off/direct/mxu_band/bf16in",
+        halo_mult=2,
+        compute_unit="mxu_band",
+        mxu_input="bf16",
     ),
     ProgramSpec(
         "step:wavefront/off/direct/bf16/uneven",
@@ -181,6 +200,7 @@ def covered_axis_values() -> dict:
         "STREAM_OVERLAP": set(),
         "STREAM_HALO": set(),
         "COMPUTE_UNITS": set(),
+        "MXU_INPUTS": set(),
         "STORAGE_DTYPES": set(),
     }
     for s in CANONICAL_PROGRAMS:
@@ -188,6 +208,7 @@ def covered_axis_values() -> dict:
         out["STREAM_OVERLAP"].add(s.overlap)
         out["STREAM_HALO"].add(s.halo)
         out["COMPUTE_UNITS"].add(s.compute_unit)
+        out["MXU_INPUTS"].add(s.mxu_input)
         out["STORAGE_DTYPES"].add(s.storage_dtype)
     return out
 
@@ -265,8 +286,11 @@ def build_program(spec: ProgramSpec) -> ProgramArtifact:
             stream_overlap=spec.overlap,
             stream_halo=spec.halo,
             compute_unit=spec.compute_unit,
+            mxu_input=spec.mxu_input,
         )
-        if spec.compute_unit == "mxu":
+        from stencil_tpu.ops.jacobi_pallas import unit_uses_mxu
+
+        if unit_uses_mxu(spec.compute_unit):
             kw["mxu_kernel"] = mean6_kernel_mxu
         step = dd.make_step(mean6_kernel, **kw)
         return step_artifact(dd, step, label=spec.label, axes=spec.axes)

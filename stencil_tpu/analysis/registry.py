@@ -45,7 +45,11 @@ CANONICAL_AXES = {
     },
     "COMPUTE_UNITS": {
         "module": "stencil_tpu/ops/jacobi_pallas.py",
-        "covered": ("vpu", "mxu"),
+        "covered": ("vpu", "mxu", "mxu_band"),
+    },
+    "MXU_INPUTS": {
+        "module": "stencil_tpu/ops/jacobi_pallas.py",
+        "covered": ("f32", "bf16"),
     },
     "STORAGE_DTYPES": {
         "module": "stencil_tpu/ops/jacobi_pallas.py",

@@ -204,9 +204,19 @@ def add_kernel_axis_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--compute-unit",
         default="auto",
-        choices=("auto", "vpu", "mxu"),
+        choices=("auto", "vpu", "mxu", "mxu_band"),
         help="level-kernel execution unit: vpu roll+add chain vs one banded "
-        "contraction per axis on the MXU (auto = env > tuned config > vpu)",
+        "contraction per axis on the MXU — dense circulant (mxu) or the "
+        "blocked (2r+1)-band tiling (mxu_band, ~n/(2r+1)x fewer FLOPs) "
+        "(auto = env > tuned config > vpu)",
+    )
+    p.add_argument(
+        "--mxu-input",
+        default="auto",
+        choices=("auto", "f32", "bf16"),
+        help="MXU contraction operand precision: bf16 inputs double the "
+        "matrix unit's FLOP ratio under the unchanged f32-accumulate "
+        "contract (auto = env > tuned config > f32; inert under vpu)",
     )
     p.add_argument(
         "--storage-dtype",
@@ -223,9 +233,12 @@ def kernel_axis_kwargs(args) -> dict:
     maps to None = consult the resolution chain)."""
     out = {}
     cu = getattr(args, "compute_unit", "auto")
+    mi = getattr(args, "mxu_input", "auto")
     sd = getattr(args, "storage_dtype", "auto")
     if cu != "auto":
         out["compute_unit"] = cu
+    if mi != "auto":
+        out["mxu_input"] = mi
     if sd != "auto":
         out["storage_dtype"] = sd
     return out

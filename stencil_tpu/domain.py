@@ -1153,8 +1153,14 @@ class DistributedDomain:
         compute_unit: str = "auto",  # stream engine: the level kernels'
         # execution unit (ops/jacobi_pallas COMPUTE_UNITS): "mxu" routes
         # the separable in-plane taps through banded contractions on the
-        # matrix unit — needs `mxu_kernel`; "auto" resolves env > tuned >
-        # the static vpu (docs/tuning.md "Compute unit and storage dtype")
+        # matrix unit — needs `mxu_kernel`; "mxu_band" runs the blocked
+        # (2r+1)-band form of the same contraction; "auto" resolves env >
+        # tuned > the static vpu (docs/tuning.md "Compute unit and
+        # storage dtype")
+        mxu_input: str = "auto",  # stream engine: MXU contraction operand
+        # precision (ops/jacobi_pallas MXU_INPUTS): "bf16" narrows the
+        # operands under the unchanged f32-accumulate contract; "auto"
+        # resolves env > tuned > the static f32; inert under vpu
         mxu_kernel=None,  # stream engine: the kernel's DECLARED
         # axis-separable contraction form, written against
         # PlaneView.plane_nbr_sum (≤1 ulp/level vs `kernel`); None =
@@ -1209,6 +1215,7 @@ class DistributedDomain:
                 separable=separable, interpret=interpret, donate=donate,
                 max_depth=stream_depth, overlap=stream_overlap,
                 halo=stream_halo, compute_unit=compute_unit,
+                mxu_input=mxu_input,
                 mxu_kernel=mxu_kernel,
             )
         if engine != "xla":

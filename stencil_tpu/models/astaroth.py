@@ -76,10 +76,14 @@ class AstarothSim:
         # route (ops/exchange.py EXCHANGE_ROUTES; None/"auto" = env >
         # tuned > static direct)
         compute_unit: str = "auto",  # pallas engine only: the level
-        # kernels' execution unit ("vpu" | "mxu" | "auto" = env > tuned >
-        # static vpu).  mxu runs ``_kernel_mxu`` — the same mean-of-6
-        # written through the views' banded-contraction seam
-        # (PlaneView.plane_nbr_sum; ≤1 ulp/level vs vpu)
+        # kernels' execution unit ("vpu" | "mxu" | "mxu_band" | "auto" =
+        # env > tuned > static vpu).  The mxu units run ``_kernel_mxu`` —
+        # the same mean-of-6 written through the views' banded-contraction
+        # seam (PlaneView.plane_nbr_sum; ≤1 ulp/level vs vpu; mxu_band =
+        # the blocked band form)
+        mxu_input: str = "auto",  # pallas engine only: MXU contraction
+        # operand precision ("f32" | "bf16" | "auto" = env > tuned >
+        # static f32); inert under vpu
         storage_dtype: str = None,  # field buffers' storage axis ("native"
         # | "bf16" | None/"auto" = env > tuned > static native): bf16
         # stores f32 fields at 2 B/cell end-to-end while the stream kernels
@@ -105,6 +109,7 @@ class AstarothSim:
         if exchange_route not in (None, "auto"):
             self.dd.set_exchange_route(exchange_route)
         self.compute_unit = compute_unit
+        self.mxu_input = mxu_input
         self.storage_dtype_request = storage_dtype
         self._storage_dtype = "native"
         if check_divergence_every:
@@ -172,6 +177,7 @@ class AstarothSim:
                 stream_overlap=self.stream_overlap,
                 stream_halo=self.stream_halo,
                 compute_unit=self.compute_unit,
+                mxu_input=self.mxu_input,
                 # the declared axis-separable contraction form — what lets
                 # compute_unit=mxu engage on this kernel
                 mxu_kernel=self._kernel_mxu,

@@ -55,11 +55,19 @@ class Jacobi3D:
         # env (STENCIL_Z_RING) > tuned config > ring default; structural
         # gates (lane alignment, slab mode) still apply either way
         compute_unit: str = None,  # level kernels' execution unit ("vpu" |
-        # "mxu" | None/"auto"): mxu contracts the in-plane taps against
-        # banded coefficient matrices on the matrix unit (≤1 ulp/level vs
-        # vpu).  None/"auto" = STENCIL_COMPUTE_UNIT > tuned config > static
-        # vpu; structural guards (non-f32 compute, routes with no
-        # contraction kernel) degrade to vpu with a warning
+        # "mxu" | "mxu_band" | None/"auto"): mxu contracts the in-plane
+        # taps against banded coefficient matrices on the matrix unit (≤1
+        # ulp/level vs vpu); mxu_band runs the blocked (2r+1)-band form of
+        # the same contraction (ulp-pinned vs dense, ~n/(2r+1)× fewer
+        # FLOPs).  None/"auto" = STENCIL_COMPUTE_UNIT > tuned config >
+        # static vpu; structural guards (non-f32 compute, routes with no
+        # contraction kernel, untilable plane geometry for the band form)
+        # degrade with a warning
+        mxu_input: str = None,  # MXU contraction operand precision ("f32"
+        # | "bf16" | None/"auto" = STENCIL_MXU_INPUT > tuned config >
+        # static f32): bf16 narrows the operands (~2× MXU ratio) under the
+        # unchanged f32-accumulate contract — analytic bound
+        # tests/ulp.mxu_bf16_input_atol; inert under vpu
         storage_dtype: str = None,  # field buffers' storage axis ("native"
         # | "bf16" | None/"auto"): bf16 stores f32 fields at 2 B/cell
         # end-to-end (HBM, VMEM pipeline, exchange messages) while the
@@ -87,9 +95,11 @@ class Jacobi3D:
         self.wavefront_alias_request = wavefront_alias
         self.z_ring_request = z_ring
         self.compute_unit_request = compute_unit
+        self.mxu_input_request = mxu_input
         self.storage_dtype_request = storage_dtype
         # resolved axes (realize() / the step builders fill these in)
         self._compute_unit = "vpu"
+        self._mxu_input = "f32"
         self._storage_dtype = "native"
         self._mxu_flops_iter = 0  # analytic MXU FLOPs per raw iteration
         if check_divergence_every:
@@ -252,14 +262,17 @@ class Jacobi3D:
             resolve_compute_unit,
         )
 
-        p_mxu = False
+        p_mxu = False  # False or the prospective unit string — the VMEM
+        # model prices the resolved variant (dense constants vs band tiles)
         if mxu_supported([self.h.dtype]):  # else build-time warns once
             cfg0 = tune.best_config(dd.tune_key("jacobi-wavefront")) or {}
             p_unit, _ = resolve_compute_unit(
                 self.compute_unit_request, cfg0.get("compute_unit"),
                 [self.h.dtype], where="jacobi-wavefront", emit=False,
             )
-            p_mxu = p_unit == "mxu"
+            from stencil_tpu.ops.jacobi_pallas import unit_uses_mxu
+
+            p_mxu = p_unit if unit_uses_mxu(p_unit) else False
         # planning diagnostics for the autotuner's candidate-space builder
         # (tune/runners.autotune_jacobi_wavefront)
         self._wavefront_plan_info = {
@@ -379,6 +392,8 @@ class Jacobi3D:
         from stencil_tpu.ops.jacobi_pallas import (
             mxu_flops_per_plane,
             resolve_compute_unit,
+            resolve_mxu_input,
+            unit_uses_mxu,
         )
 
         unit, _unit_src = resolve_compute_unit(
@@ -388,8 +403,15 @@ class Jacobi3D:
             where="jacobi-wavefront",
         )
         self._compute_unit = unit
+        mi, _mi_src = resolve_mxu_input(
+            self.mxu_input_request, tuned.get("mxu_input"), unit,
+            where="jacobi-wavefront",
+        )
+        self._mxu_input = mi
         f32_acc = dd.field_dtype(self.h) != self.h.dtype
-        kern_kw = {"compute_unit": unit, "f32_accumulate": f32_acc}
+        kern_kw = {
+            "compute_unit": unit, "f32_accumulate": f32_acc, "mxu_input": mi,
+        }
         z_slab_mode = env_bool("STENCIL_Z_SLABS", True) and getattr(
             self, "_wavefront_z_planned", False
         )
@@ -450,11 +472,18 @@ class Jacobi3D:
         # amortized over the device-side macro loop.
         Zp = lane_pad_width(Zr) if z_slab_mode else Zr
         # analytic MXU FLOPs per raw iteration (all shards): one band
-        # contraction pair per streamed plane per level — the
-        # kernel.mxu.flops counter's per-step increment (step())
+        # contraction pair per streamed plane per level, counted for the
+        # RESOLVED variant (the dense model over-reports a band-tiled run
+        # by ~n/(2r+1)) on the plane geometry the kernel actually
+        # CONTRACTS — the z-ring kernel works over the (Yr, OFF + Zi)
+        # ring plane, the padded-shell kernel over (Yr, Zp); the variant
+        # a geometry admits (band_tile_plan) differs with the width, so
+        # pricing the wrong plane could count the wrong variant — the
+        # kernel.mxu.flops per-step increment (step())
+        _flops_pz = (_ZRING_OFF + n.z) if z_ring_mode else Zp
         self._mxu_flops_iter = (
-            mxu_flops_per_plane(Yr, Zp) * Xr * dd.num_subdomains()
-            if unit == "mxu"
+            mxu_flops_per_plane(Yr, _flops_pz, unit) * Xr * dd.num_subdomains()
+            if unit_uses_mxu(unit)
             else 0
         )
 
@@ -660,6 +689,8 @@ class Jacobi3D:
             from stencil_tpu.ops.jacobi_pallas import (
                 mxu_flops_per_plane,
                 resolve_compute_unit,
+                resolve_mxu_input,
+                unit_uses_mxu,
             )
 
             cfg = tune.best_config(dd.tune_key("jacobi-wrap")) or {}
@@ -670,21 +701,32 @@ class Jacobi3D:
                 where="jacobi-wrap",
             )
             self._compute_unit = unit
+            mi, _mi_src = resolve_mxu_input(
+                self.mxu_input_request, cfg.get("mxu_input"), unit,
+                where="jacobi-wrap",
+            )
+            self._mxu_input = mi
             # ring carries the f32_accumulate working precision, so the
             # VMEM model takes both (a storage-only model under bf16 would
-            # admit depths whose f32 ring blows the budget)
+            # admit depths whose f32 ring blows the budget); the mxu term
+            # prices the RESOLVED variant (dense constants vs band tiles)
             k = choose_temporal_k(
                 (n.x, n.y, n.z), dd.field_dtype(self.h).itemsize,
                 self.temporal_k,
                 tune_key=dd.tune_key("jacobi-wrap"),
                 ring_itemsize=self.h.dtype.itemsize,
-                mxu=unit == "mxu",
+                mxu=unit if unit_uses_mxu(unit) else False,
             )
             self._wrap_k = k
             f32_acc = dd.field_dtype(self.h) != self.h.dtype
-            kern_kw = {"compute_unit": unit, "f32_accumulate": f32_acc}
+            kern_kw = {
+                "compute_unit": unit, "f32_accumulate": f32_acc,
+                "mxu_input": mi,
+            }
             self._mxu_flops_iter = (
-                mxu_flops_per_plane(n.y, n.z) * n.x if unit == "mxu" else 0
+                mxu_flops_per_plane(n.y, n.z, unit) * n.x
+                if unit_uses_mxu(unit)
+                else 0
             )
 
             @partial(jax.jit, static_argnums=1, donate_argnums=0)
@@ -925,7 +967,9 @@ class Jacobi3D:
     def _rung_name(self) -> str:
         if self.kernel_impl != "pallas":
             return "xla"
-        suffix = ",mxu" if self._compute_unit == "mxu" else ""
+        suffix = (
+            f",{self._compute_unit}" if self._compute_unit != "vpu" else ""
+        )
         if self.dd.storage_dtype() == "bf16":
             suffix += ",bf16"
         if self._pallas_path == "wrap":
@@ -975,7 +1019,19 @@ class Jacobi3D:
         # the new-axis rungs come BEFORE any depth descent: an mxu or bf16
         # build carries its own extra compiler surface (band matmuls /
         # mixed-dtype pipelines), so the failure may be the axis's fault,
-        # not the depth's — step the axis down at the SAME depth first
+        # not the depth's — step the axis down at the SAME depth first.
+        # The contraction walks band → dense → vpu: the blocked form's
+        # reshape/batched-dot lowering may be what the compiler rejected
+        # while the dense contraction still serves the matrix unit.
+        if self._compute_unit == "mxu_band":
+            log_warn(
+                f"compute_unit=mxu_band on the {self._pallas_path} route "
+                f"exceeded the compiler's capability ({cls.value}); stepping "
+                "down to the dense mxu form at the same depth"
+            )
+            self.compute_unit_request = "mxu"  # forced for the rebuild
+            self._rebuild_current_route()
+            return True
         if self._compute_unit == "mxu":
             log_warn(
                 f"compute_unit=mxu on the {self._pallas_path} route exceeded "

@@ -24,9 +24,11 @@ def mean6_shell_wavefront_step(
     interpret: bool = False,
     compute_unit: str = "vpu",  # "mxu" = one banded in-plane contraction
     # per axis on the matrix unit (ops/jacobi_pallas.band_matrix); ≤1
-    # ulp/level vs the "vpu" roll+add chain
+    # ulp/level vs the "vpu" roll+add chain; "mxu_band" = its blocked
+    # (2r+1)-band form (ops/jacobi_pallas.band_wide_tile)
     f32_accumulate: bool = False,  # bf16-storage variant: upcast at load,
     # f32 level ring + arithmetic, one downcast at the final store
+    mxu_input: str = "f32",  # MXU operand precision (jacobi_wrap_step)
 ) -> jax.Array:
     """``m`` mean-of-6 levels in ONE pass over an s-shell-carrying shard —
     the Astaroth proxy's temporal wavefront (opt-in ``schedule="wavefront"``).
@@ -48,7 +50,10 @@ def mean6_shell_wavefront_step(
         _make_level_sum,
         _make_roll,
         _tpu_compiler_params,
-        band_matrix,
+        band_operands,
+        make_plane_nbr_sum,
+        plane_band_unit,
+        unit_uses_mxu,
     )
 
     Xr, Yr, Zr = raw.shape
@@ -58,8 +63,13 @@ def mean6_shell_wavefront_step(
     roll = _make_roll(interpret)
     acc_dtype = jnp.float32 if f32_accumulate else raw.dtype
     _check_compute_unit(compute_unit, acc_dtype)
-    mxu = compute_unit == "mxu"
-    level_sum = _make_level_sum(roll, compute_unit)
+    mxu = unit_uses_mxu(compute_unit)
+    if mxu:
+        compute_unit = plane_band_unit(compute_unit, Yr, Zr, where="mean6-wavefront")
+    nbr_sum = (
+        make_plane_nbr_sum(Yr, Zr, compute_unit, mxu_input) if mxu else None
+    )
+    level_sum = _make_level_sum(roll, compute_unit, nbr_sum)
 
     def kernel(in_ref, *rest):
         if mxu:
@@ -84,11 +94,9 @@ def mean6_shell_wavefront_step(
     in_specs = [pl.BlockSpec((1, Yr, Zr), lambda i: (i, 0, 0))]
     args = [raw]
     if mxu:
-        in_specs += [
-            pl.BlockSpec((Yr, Yr), lambda i: (0, 0)),
-            pl.BlockSpec((Zr, Zr), lambda i: (0, 0)),
-        ]
-        args += [band_matrix(Yr), band_matrix(Zr)]
+        b_args, b_specs = band_operands(Yr, Zr, compute_unit, mxu_input)
+        in_specs += b_specs
+        args += b_args
     return pl.pallas_call(
         kernel,
         grid=(Xr,),
@@ -106,24 +114,29 @@ def mean6_shell_wavefront_step(
 def mean6_plane_step(
     block: jax.Array, lo: Dim3, hi: Dim3, interpret: bool = False,
     compute_unit: str = "vpu", f32_accumulate: bool = False,
+    mxu_input: str = "f32",
 ) -> jax.Array:
     """One mean-of-6-face-neighbors iteration over a shell-carrying block.
 
     ``compute_unit="mxu"`` computes the in-plane neighbor pair sums as one
-    banded contraction per axis (``band_matrix``); the interior window
-    ``[y0, y1) x [z0, z1)`` sits at least one cell inside the plane, so the
-    circulant wrap rows/columns never enter the sliced result and the
-    contraction is exactly the shifted-slice sum up to summation order
-    (≤1 ulp).  ``f32_accumulate`` is the bf16-storage variant: the mean is
-    computed at f32 and rounded once at the interior store (pass-through
-    shell planes keep their storage bytes untouched)."""
+    banded contraction per axis (``band_matrix``; ``"mxu_band"`` runs the
+    blocked form); the interior window ``[y0, y1) x [z0, z1)`` sits at
+    least one cell inside the plane, so the circulant wrap rows/columns
+    never enter the sliced result and the contraction is exactly the
+    shifted-slice sum up to summation order (≤1 ulp).  ``f32_accumulate``
+    is the bf16-storage variant: the mean is computed at f32 and rounded
+    once at the interior store (pass-through shell planes keep their
+    storage bytes untouched)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     from stencil_tpu.ops.jacobi_pallas import (
         _check_compute_unit,
         _tpu_compiler_params,
-        band_matrix,
+        band_operands,
+        make_plane_nbr_sum,
+        plane_band_unit,
+        unit_uses_mxu,
     )
 
     X, Y, Z = block.shape
@@ -134,7 +147,12 @@ def mean6_plane_step(
     z0, z1 = lo.z, Z - hi.z
     acc_dtype = jnp.float32 if f32_accumulate else block.dtype
     _check_compute_unit(compute_unit, acc_dtype)
-    mxu = compute_unit == "mxu"
+    mxu = unit_uses_mxu(compute_unit)
+    if mxu:
+        compute_unit = plane_band_unit(compute_unit, Y, Z, where="mean6-plane")
+    nbr_sum = (
+        make_plane_nbr_sum(Y, Z, compute_unit, mxu_input) if mxu else None
+    )
     up = (lambda v: v.astype(jnp.float32)) if f32_accumulate else (lambda v: v)
 
     def kernel(in_ref, *rest):
@@ -160,12 +178,7 @@ def mean6_plane_step(
                 prev = ring[i % 2]  # plane i-2
                 if mxu:
                     c = up(cent)
-                    dn = (((1,), (0,)), ((), ()))
-                    nbr = jax.lax.dot_general(
-                        by_ref[...], c, dn, preferred_element_type=jnp.float32
-                    ) + jax.lax.dot_general(
-                        c, bz_ref[...], dn, preferred_element_type=jnp.float32
-                    )
+                    nbr = nbr_sum(c, by_ref[...], bz_ref[...])
                     mean = (
                         up(prev[y0:y1, z0:z1])
                         + up(cur[y0:y1, z0:z1])
@@ -194,11 +207,9 @@ def mean6_plane_step(
     in_specs = [pl.BlockSpec((1, Y, Z), lambda i: (jnp.minimum(i, X - 1), 0, 0))]
     args = [block]
     if mxu:
-        in_specs += [
-            pl.BlockSpec((Y, Y), lambda i: (0, 0)),
-            pl.BlockSpec((Z, Z), lambda i: (0, 0)),
-        ]
-        args += [band_matrix(Y), band_matrix(Z)]
+        b_args, b_specs = band_operands(Y, Z, compute_unit, mxu_input)
+        in_specs += b_specs
+        args += b_args
     return pl.pallas_call(
         kernel,
         grid=(X + 1,),

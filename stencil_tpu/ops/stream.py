@@ -110,15 +110,20 @@ from stencil_tpu import telemetry
 from stencil_tpu.telemetry import names as tm
 from stencil_tpu.ops.jacobi_pallas import (
     COMPUTE_UNITS,
+    MXU_INPUTS,
     _make_roll,
     _padded_plane_bytes,
     _tpu_compiler_params,
     _vmem_budget,
     _VMEM_STACK_MARGIN,
     _WRAP_MAX_K,
-    band_matrix,
+    band_operands,
+    make_plane_nbr_sum,
     mxu_flops_per_plane,
+    plane_band_unit,
     resolve_compute_unit,
+    resolve_mxu_input,
+    unit_uses_mxu,
 )
 
 
@@ -149,19 +154,22 @@ class PlaneView:
     ``plane_nbr_sum()`` is the compute-unit seam for AXIS-SEPARABLE
     kernels: the sum of the four in-plane face neighbors of the center
     plane, lowered as the historical roll+add chain under ``vpu`` or as ONE
-    banded contraction per axis on the matrix unit under ``mxu``
-    (``bands`` set — ops/jacobi_pallas.band_matrix; ≤1 ulp vs the chain,
-    a pure summation-order difference).  A kernel's ``mxu`` form
-    (``make_stream_step(mxu_kernel=...)``) writes its separable in-plane
-    taps through this helper; kernels with no such form never see bands
-    and structurally degrade to ``vpu``.
+    banded contraction per axis on the matrix unit under the MXU units
+    (``bands`` set — the dense ``band_matrix`` circulants under ``mxu``,
+    the blocked ``band_wide_tile`` form under ``mxu_band``; ulp-pinned vs
+    the chain, a pure summation-order difference).  A kernel's ``mxu``
+    form (``make_stream_step(mxu_kernel=...)``) writes its separable
+    in-plane taps through this helper; kernels with no such form never see
+    bands and structurally degrade to ``vpu``.
     """
 
     def __init__(self, window: Tuple[jax.Array, ...], roll, bands=None):
         self._window = window
         self._r = (len(window) - 1) // 2
         self._roll = roll
-        self._bands = bands  # (by, bz) f32 band matrices, or None (= vpu)
+        self._bands = bands  # nbr_sum(center) closure over the resident
+        # contraction constants (ops/jacobi_pallas.make_plane_nbr_sum
+        # bound to this pass's refs), or None (= vpu)
 
     def sh(self, dx: int = 0, dy: int = 0, dz: int = 0) -> jax.Array:
         # ALL axes are bounded by the declared read radius: an in-plane
@@ -180,16 +188,10 @@ class PlaneView:
 
     def plane_nbr_sum(self) -> jax.Array:
         """``sh(0,1,0) + sh(0,-1,0) + sh(0,0,1) + sh(0,0,-1)`` — on the MXU
-        as two banded matmuls when this view carries band matrices."""
+        as banded contractions when this view carries band constants."""
         c = self.center()
         if self._bands is not None:
-            by, bz = self._bands
-            dn = (((1,), (0,)), ((), ()))
-            return jax.lax.dot_general(
-                by, c, dn, preferred_element_type=jnp.float32
-            ) + jax.lax.dot_general(
-                c, bz, dn, preferred_element_type=jnp.float32
-            )
+            return self._bands(c)
         return (
             self.sh(0, 1, 0)
             + self.sh(0, -1, 0)
@@ -257,6 +259,23 @@ def _fused_plane_patch(v, xplane, yst, zst, t, lo_y, hi_y, lo_z, hi_z):
     return v
 
 
+def _pass_band_setup(compute_unit: str, mxu_input: str, plane_y: int,
+                     plane_z: int, where: str):
+    """``(effective unit, band args, band in_specs, nbr_sum)`` for one
+    streaming pass's plane geometry — empty/None pieces under ``vpu``.
+    Each pass tiles its OWN geometry (the split schedule's narrow band
+    sub-blocks differ from the interior pass), so the band→dense
+    structural degrade (``plane_band_unit``) is per pass; the contraction
+    VALUES stay identical across variants up to summation order, so the
+    pass outputs keep the documented ulp pins either way."""
+    if not unit_uses_mxu(compute_unit):
+        return compute_unit, [], [], None
+    unit = plane_band_unit(compute_unit, plane_y, plane_z, where=where)
+    args, specs = band_operands(plane_y, plane_z, unit, mxu_input)
+    nbr = make_plane_nbr_sum(plane_y, plane_z, unit, mxu_input)
+    return unit, args, specs, nbr
+
+
 def stream_plane_pass(
     kernel: PlaneKernel,
     names: Sequence[str],
@@ -267,8 +286,10 @@ def stream_plane_pass(
     origin: jax.Array,  # (3,) int32 global coords of the interior start
     global_size: Dim3,
     interpret: bool = False,
-    compute_unit: str = "vpu",  # "mxu": band matrices ride in as resident
-    # constants and the views' plane_nbr_sum contracts on the matrix unit
+    compute_unit: str = "vpu",  # "mxu"/"mxu_band": band constants ride in
+    # as resident inputs and the views' plane_nbr_sum contracts on the
+    # matrix unit (dense circulants vs blocked band tiles)
+    mxu_input: str = "f32",  # MXU operand precision (jacobi_wrap_step)
     f32_accumulate: bool = False,  # bf16-storage variant: planes upcast to
     # f32 for the kernel, one downcast at the interior store (pass-through
     # shell planes keep their storage bytes bit-exact)
@@ -300,14 +321,17 @@ def stream_plane_pass(
     z0, z1 = lo.z, Z - hi.z
     roll = _make_roll(interpret)
     gsize = global_size
-    mxu = compute_unit == "mxu"
+    compute_unit, b_args, b_specs, nbr = _pass_band_setup(
+        compute_unit, mxu_input, Y, Z, "stream-plane"
+    )
+    mxu = unit_uses_mxu(compute_unit)
     up = (lambda v: v.astype(jnp.float32)) if f32_accumulate else (lambda v: v)
 
     def body(origin_ref, *refs):
         in_refs = refs[:nq]
         if mxu:
-            by_ref, bz_ref = refs[nq], refs[nq + 1]
-            bands = (by_ref[...], bz_ref[...])
+            b1, b2 = refs[nq][...], refs[nq + 1][...]
+            bands = lambda c: nbr(c, b1, b2)
             refs = refs[: nq] + refs[nq + 2 :]
         else:
             bands = None
@@ -395,12 +419,9 @@ def stream_plane_pass(
     ]
     args = [origin.astype(jnp.int32), *raws]
     if mxu:
-        # resident band-matrix constants, fetched once like the d2 plane
-        in_specs += [
-            pl.BlockSpec((Y, Y), lambda i: (0, 0)),
-            pl.BlockSpec((Z, Z), lambda i: (0, 0)),
-        ]
-        args += [band_matrix(Y), band_matrix(Z)]
+        # resident contraction constants, fetched once like the d2 plane
+        in_specs += b_specs
+        args += b_args
     if fused_shell is not None:
         xs_list, ys_list, zs_list = fused_shell
         assert all(b.shape == (lo.x + hi.x, Y, Z) for b in xs_list)
@@ -469,8 +490,9 @@ def stream_wavefront_pass(
     z_valid: int = None,  # logical plane width; [z_valid, Zr) is lane padding
     alias: bool = False,
     interpret: bool = False,
-    compute_unit: str = "vpu",  # "mxu": resident band matrices + contraction
-    # via the views' plane_nbr_sum (see stream_plane_pass)
+    compute_unit: str = "vpu",  # "mxu"/"mxu_band": resident band constants
+    # + contraction via the views' plane_nbr_sum (see stream_plane_pass)
+    mxu_input: str = "f32",  # MXU operand precision (jacobi_wrap_step)
     f32_accumulate: bool = False,  # bf16-storage variant: upcast at load,
     # f32 level rings + arithmetic, one downcast at the final store/emit
     fused_shell=None,  # (xbufs, ybufs, zbufs) per quantity — the packed
@@ -501,7 +523,10 @@ def stream_wavefront_pass(
     gsize = global_size
     assert 2 * s_off < gsize.x, (s_off, gsize)  # non-negative lax.rem operand
     roll = _make_roll(interpret)
-    mxu = compute_unit == "mxu"
+    compute_unit, b_args, b_specs, nbr = _pass_band_setup(
+        compute_unit, mxu_input, Yr, Zr, "stream-wavefront"
+    )
+    mxu = unit_uses_mxu(compute_unit)
     acc_dtypes = [
         jnp.float32 if f32_accumulate else b.dtype for b in raws
     ]
@@ -511,7 +536,8 @@ def stream_wavefront_pass(
         in_refs = refs[:nq]
         refs = refs[nq:]
         if mxu:
-            bands = (refs[0][...], refs[1][...])
+            b1, b2 = refs[0][...], refs[1][...]
+            bands = lambda c: nbr(c, b1, b2)
             refs = refs[2:]
         else:
             bands = None
@@ -603,11 +629,8 @@ def stream_wavefront_pass(
     ]
     args = [origin.astype(jnp.int32), *raws]
     if mxu:
-        in_specs += [
-            pl.BlockSpec((Yr, Yr), lambda i: (0, 0)),
-            pl.BlockSpec((Zr, Zr), lambda i: (0, 0)),
-        ]
-        args += [band_matrix(Yr), band_matrix(Zr)]
+        in_specs += b_specs
+        args += b_args
     if fused_shell is not None:
         xs_list, ys_list, zs_list = fused_shell
         s = s_off
@@ -680,8 +703,9 @@ def stream_wrap_pass(
     origin: jax.Array,  # (3,) int32 — global coords of the block start
     global_size: Dim3,
     interpret: bool = False,
-    compute_unit: str = "vpu",  # "mxu": resident band matrices + contraction
-    # via the views' plane_nbr_sum (see stream_plane_pass)
+    compute_unit: str = "vpu",  # "mxu"/"mxu_band": resident band constants
+    # + contraction via the views' plane_nbr_sum (see stream_plane_pass)
+    mxu_input: str = "f32",  # MXU operand precision (jacobi_wrap_step)
     f32_accumulate: bool = False,  # bf16-storage variant (see
     # stream_wavefront_pass)
 ) -> List[jax.Array]:
@@ -699,7 +723,10 @@ def stream_wrap_pass(
     assert 1 <= k <= X // 2, (k, X)
     roll = _make_roll(interpret)
     gsize = global_size
-    mxu = compute_unit == "mxu"
+    compute_unit, b_args, b_specs, nbr = _pass_band_setup(
+        compute_unit, mxu_input, Y, Z, "stream-wrap"
+    )
+    mxu = unit_uses_mxu(compute_unit)
     acc_dtypes = [
         jnp.float32 if f32_accumulate else b.dtype for b in blocks
     ]
@@ -709,7 +736,8 @@ def stream_wrap_pass(
         in_refs = refs[:nq]
         refs = refs[nq:]
         if mxu:
-            bands = (refs[0][...], refs[1][...])
+            b1, b2 = refs[0][...], refs[1][...]
+            bands = lambda c: nbr(c, b1, b2)
             refs = refs[2:]
         else:
             bands = None
@@ -749,11 +777,8 @@ def stream_wrap_pass(
     ]
     args = [origin.astype(jnp.int32), *blocks]
     if mxu:
-        in_specs += [
-            pl.BlockSpec((Y, Y), lambda i: (0, 0)),
-            pl.BlockSpec((Z, Z), lambda i: (0, 0)),
-        ]
-        args += [band_matrix(Y), band_matrix(Z)]
+        in_specs += b_specs
+        args += b_args
     outs = pl.pallas_call(
         body,
         grid=(X + 2 * k,),
@@ -825,9 +850,14 @@ def _tuned_stream_plan(dd, x_radius: int, separable: bool) -> dict:
     if cfg.get("overlap") is not None:
         plan["overlap"] = cfg["overlap"]
     # the compute-unit axis rides the same no-schema-bump rule: absent =
-    # the static vpu, garbage invalidates the plan below
+    # the static vpu, garbage invalidates the plan below.  Pre-variant
+    # entries (``mxu`` from before the band form existed) stay warm: the
+    # value is still in the vocabulary
     if cfg.get("compute_unit") is not None:
         plan["compute_unit"] = cfg["compute_unit"]
+    # ...and the MXU input-precision axis: absent = the static f32
+    if cfg.get("mxu_input") is not None:
+        plan["mxu_input"] = cfg["mxu_input"]
     # ...and so does the fused-halo axis: pre-halo entries lack the key and
     # resolve to the static "array"; garbage invalidates to static
     if cfg.get("halo") is not None:
@@ -843,6 +873,8 @@ def _tuned_stream_plan(dd, x_radius: int, separable: bool) -> dict:
         ok = plan["halo"] in STREAM_HALO
     if ok and plan.get("compute_unit") is not None:
         ok = plan["compute_unit"] in COMPUTE_UNITS
+    if ok and plan.get("mxu_input") is not None:
+        ok = plan["mxu_input"] in MXU_INPUTS
     if ok and plan["grouping"] == "per-field":
         ok = separable and len(dd._handles) > 1
     elif ok and plan["grouping"] != "joint":
@@ -1398,12 +1430,25 @@ def _build_stream_step(dd, kernel, x_radius, plan, interpret, donate=True,
         ),
     )
     plan["compute_unit"] = compute_unit
-    if compute_unit == "mxu":
+    # MXU input precision (ops/jacobi_pallas MXU_INPUTS): resolved AFTER
+    # the unit (bf16 inputs only exist under an engaged MXU unit) through
+    # the same forced > env > tuned > static chain
+    mi_req = plan.get("mxu_input") if plan.get("mxu_input_forced") else None
+    mi_tuned = None if mi_req is not None else plan.get("mxu_input")
+    mxu_input, _mi_src = resolve_mxu_input(
+        mi_req, mi_tuned, compute_unit, where=f"stream:{plan['route']}"
+    )
+    plan["mxu_input"] = mxu_input
+    if unit_uses_mxu(compute_unit):
         # the mxu form is the SAME stencil written through the views'
         # plane_nbr_sum seam; every pass (interior, exterior bands, wrap)
         # runs it, so the split-schedule bitwise argument holds per unit
         kernel = mxu_kernel
-    unit_kw = {"compute_unit": compute_unit, "f32_accumulate": f32_acc}
+    unit_kw = {
+        "compute_unit": compute_unit,
+        "f32_accumulate": f32_acc,
+        "mxu_input": mxu_input,
+    }
 
     if split:
         from stencil_tpu.ops import halo_blend
@@ -1825,6 +1870,7 @@ def make_stream_step(
     overlap: str = "auto",
     halo: str = "auto",
     compute_unit: str = "auto",
+    mxu_input: str = "auto",
     mxu_kernel: PlaneKernel = None,
 ):
     """Build a ``step(curr, steps) -> curr`` running ``kernel`` under the
@@ -1873,10 +1919,21 @@ def make_stream_step(
     banded contraction per axis on the matrix unit, which requires the
     kernel's declared contraction form ``mxu_kernel`` — the SAME stencil
     written against ``PlaneView.plane_nbr_sum`` (pinned ≤1 ulp/level
-    against the vpu form).  A kernel with no mxu form, or non-f32 compute
-    dtypes, degrades to ``vpu`` with a warning; a compile-rejected mxu
-    build steps down to ``vpu`` at the same depth through the ladder
-    before any depth descent.
+    against the vpu form); ``"mxu_band"`` tiles that contraction to the
+    band's nonzeros (blocked ``(2r+1)``-band matmul — ulp-pinned against
+    the dense form, ~``n/(2r+1)``× fewer FLOPs, KB-scale resident
+    constants).  A kernel with no mxu form, or non-f32 compute dtypes,
+    degrades to ``vpu`` with a warning; an untilable plane geometry
+    degrades ``mxu_band`` to the dense form per pass; a compile-rejected
+    build steps down band → dense → vpu at the same depth through the
+    ladder before any depth descent.
+
+    ``mxu_input`` selects the contraction operand precision (a tuner
+    axis): ``"auto"`` resolves ``STENCIL_MXU_INPUT`` > the tuned config >
+    the static ``"f32"``; ``"bf16"`` feeds bfloat16 operands to the MXU
+    under the unchanged f32-accumulate contract (analytic bound
+    ``tests/ulp.mxu_bf16_input_atol``) — the ~2× ratio leg of the "VPU
+    wall" break-even model.  Structurally inert under ``vpu``.
 
     The returned step rides the resilience DEGRADATION LADDER
     (``resilience/ladder.py``): if Mosaic rejects the planned wavefront depth
@@ -1922,8 +1979,14 @@ def make_stream_step(
             f"unknown compute unit {compute_unit!r} (one of "
             f"{('auto',) + COMPUTE_UNITS})"
         )
+    if mxu_input not in ("auto",) + MXU_INPUTS:
+        raise ValueError(
+            f"unknown mxu input {mxu_input!r} (one of "
+            f"{('auto',) + MXU_INPUTS})"
+        )
     plan = plan_stream(dd, x_radius, path, separable, max_m=max_depth)
-    if overlap != "auto" or halo != "auto" or compute_unit != "auto":
+    if (overlap != "auto" or halo != "auto" or compute_unit != "auto"
+            or mxu_input != "auto"):
         plan = dict(plan)
     if overlap != "auto":
         plan["overlap"] = overlap
@@ -1934,6 +1997,9 @@ def make_stream_step(
     if compute_unit != "auto":
         plan["compute_unit"] = compute_unit
         plan["compute_unit_forced"] = True
+    if mxu_input != "auto":
+        plan["mxu_input"] = mxu_input
+        plan["mxu_input_forced"] = True
     # a split request (explicit/env/tuned) against a z-slab wavefront plan
     # re-plans to the PLAIN form when it fits: split needs z halos in the
     # big array for the exchange it overlaps, and the packed zpack_* routes
@@ -1974,8 +2040,9 @@ def make_stream_step(
         suffix = ",split" if p.get("overlap") == "split" else ""
         if p.get("halo") == "fused":
             suffix += ",fused"
-        if _prospective_unit(p) == "mxu":
-            suffix += ",mxu"
+        unit = _prospective_unit(p)
+        if unit != "vpu":
+            suffix += f",{unit}"
         return Rung(
             name=f"{p['route']}[m={p['m']}{suffix}]",
             build=lambda: _build_stream_step(
@@ -1995,8 +2062,24 @@ def make_stream_step(
         # alone would wrongly descend DEPTH for a reject that is the
         # contraction's fault (incl. the prefilter's static band-matrix
         # reject), violating the axis-drops-first-at-same-depth rule
-        if _prospective_unit(plan_now) == "mxu":
-            # first rung down: drop the MXU contraction form at the SAME
+        unit_now = _prospective_unit(plan_now)
+        if unit_now == "mxu_band":
+            # first rung down: band → DENSE at the SAME depth/schedule —
+            # the blocked form carries its own reshape/batched-dot lowering
+            # surface, so a reject may be the tiling's fault while the
+            # dense contraction still compiles
+            log_warn(
+                f"compute_unit=mxu_band on {plan_now['route']}"
+                f"[m={plan_now['m']}] exceeded the compiler's capability "
+                f"({cls.value}); stepping down to the dense mxu form at the "
+                "same depth"
+            )
+            p2 = dict(plan_now)
+            p2["compute_unit"] = "mxu"
+            p2["compute_unit_forced"] = True
+            return rung_for(p2)
+        if unit_now == "mxu":
+            # next rung down: drop the MXU contraction form at the SAME
             # depth/schedule — the band matmuls carry their own resident
             # constants and matrix-unit lowering, so a VMEM_OOM or compile
             # reject may be the contraction's fault, not the depth's
@@ -2008,6 +2091,9 @@ def make_stream_step(
             p2 = dict(plan_now)
             p2["compute_unit"] = "vpu"
             p2["compute_unit_forced"] = True
+            # moot without a contraction — pin f32 so the resolve stays quiet
+            p2["mxu_input"] = "f32"
+            p2["mxu_input_forced"] = True
             return rung_for(p2)
         if plan_now.get("halo") == "fused":
             # next rung down: drop the fused halo mode at the SAME depth —
@@ -2055,6 +2141,8 @@ def make_stream_step(
         p2["halo_forced"] = True
         p2["compute_unit"] = plan_now.get("compute_unit", "vpu")
         p2["compute_unit_forced"] = True
+        p2["mxu_input"] = plan_now.get("mxu_input", "f32")
+        p2["mxu_input_forced"] = True
         return rung_for(p2)
 
     # static VMEM prefilter (analysis/vmem.py): on real backends a rung the
@@ -2084,11 +2172,27 @@ def make_stream_step(
     band_area = 2 * (raw.y * raw.z + raw.x * raw.z + raw.x * raw.y) * len(
         dd._handles
     ) * n_doms
-    # analytic MXU FLOPs of ONE raw iteration under the contraction form
-    # (all shards, all fields; modeled on raw plane dims, like band_area)
-    mxu_flops_iter = (
-        mxu_flops_per_plane(raw.y, raw.z) * raw.x * len(dd._handles) * n_doms
-    )
+    # analytic MXU FLOPs of ONE raw iteration under the RESOLVED
+    # contraction variant (all shards, all fields) — the dense model
+    # over-reports a band-tiled run by ~n/(2r+1), which would poison every
+    # roofline and perf-ledger series built on kernel.mxu.flops.  Modeled
+    # on the plane geometry the pass actually CONTRACTS, not the raw
+    # dims: the wrap route slices the bare interior, and the z-slab
+    # wavefront lane-pads its planes — both change which band tiling (if
+    # any) engages, so raw-dims pricing could count the wrong variant
+    n_int = dd.local_spec().sz
+
+    def _mxu_flops_iter(plan_now: dict) -> int:
+        unit = plan_now.get("compute_unit", "vpu")
+        if plan_now.get("route") == "wrap":
+            py, pz, px = n_int.y, n_int.z, n_int.x
+        else:
+            py, px = raw.y, raw.x
+            pz = lane_pad_width(raw.z) if plan_now.get("z_slabs") else raw.z
+        return (
+            mxu_flops_per_plane(py, pz, unit)
+            * px * len(dd._handles) * n_doms
+        )
 
     def _exterior_cells(plan_now, steps: int) -> int:
         """Analytic cells recomputed by the exterior band passes for this
@@ -2108,8 +2212,10 @@ def make_stream_step(
         cells = _exterior_cells(plan_now, steps)
         if cells:
             telemetry.inc(tm.STEP_OVERLAP_EXTERIOR_CELLS, cells)
-        if plan_now.get("compute_unit") == "mxu":
-            telemetry.inc(tm.KERNEL_MXU_FLOPS, steps * mxu_flops_iter)
+        if unit_uses_mxu(plan_now.get("compute_unit", "vpu")):
+            telemetry.inc(
+                tm.KERNEL_MXU_FLOPS, steps * _mxu_flops_iter(plan_now)
+            )
         return out
 
     step._marks_shell_stale = True

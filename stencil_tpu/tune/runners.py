@@ -80,6 +80,7 @@ def autotune_jacobi_wrap(
         kern_kw = {
             "compute_unit": unit,
             "f32_accumulate": storage == "bf16",
+            "mxu_input": cand.get("mxu_input", "f32"),
         }
 
         @partial(jax.jit, static_argnums=1)
@@ -146,7 +147,7 @@ def autotune_jacobi_wavefront(
     dtype = jnp.dtype(dtype or jnp.float32)
 
     def make_model(temporal_k="auto", alias=None, z_ring=None,
-                   compute_unit=None, storage_dtype=None):
+                   compute_unit=None, storage_dtype=None, mxu_input=None):
         kwargs = {} if strategy is None else {"strategy": strategy}
         return Jacobi3D(
             x,
@@ -162,6 +163,7 @@ def autotune_jacobi_wavefront(
             z_ring=z_ring,
             compute_unit=compute_unit,
             storage_dtype=storage_dtype,
+            mxu_input=mxu_input,
             **kwargs,
         )
 
@@ -175,8 +177,23 @@ def autotune_jacobi_wavefront(
         getattr(probe, "_wavefront_z_planned", False)
         and info["n"][2] % 128 == 0
     )
-    from stencil_tpu.ops.jacobi_pallas import bf16_supported, mxu_supported
+    from stencil_tpu.ops.jacobi_pallas import (
+        band_tile_plan,
+        bf16_supported,
+        mxu_supported,
+    )
 
+    # the band variant needs a tilable plane geometry — the geometry the
+    # kernel CONTRACTS (lane-padded under the z-slab route), not the bare
+    # raw extent: a ragged raw width that pads to a 128 multiple tiles
+    # fine, and prefiltering on the raw dims would drop the band twins
+    # from exactly the large padded geometries they were built to win on
+    from stencil_tpu.ops.stream import lane_pad_width
+
+    n = info["n"]
+    _band_pz = n[2] + 2 * static_m
+    if getattr(probe, "_wavefront_z_planned", False):
+        _band_pz = lane_pad_width(_band_pz)
     candidates, prefiltered = space.jacobi_wavefront_space(
         static_m,
         # structural caps only (a shard must fill an m-wide halo from valid
@@ -189,6 +206,7 @@ def autotune_jacobi_wavefront(
         ms=ms,
         mxu_ok=mxu_supported([dtype]),
         bf16_ok=bf16_supported([dtype]),
+        band_ok=band_tile_plan(n[1] + 2 * static_m, _band_pz) is not None,
     )
     models = {}
 
@@ -197,6 +215,7 @@ def autotune_jacobi_wavefront(
             temporal_k=cand["m"], alias=cand["alias"], z_ring=cand.get("z_ring"),
             compute_unit=cand.get("compute_unit"),
             storage_dtype=cand.get("storage_dtype"),
+            mxu_input=cand.get("mxu_input"),
         )
         model.realize()
         models[space.candidate_label(cand)] = model  # keep resident

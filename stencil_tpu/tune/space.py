@@ -128,6 +128,7 @@ def jacobi_wrap_space(
     import jax.numpy as jnp
 
     from stencil_tpu.ops.jacobi_pallas import (
+        band_tile_plan,
         bf16_supported,
         mxu_supported,
         wavefront_vmem_fits,
@@ -149,10 +150,11 @@ def jacobi_wrap_space(
             prefiltered += 1
     # the axis A/Bs at the static depth (persisted winners carry the axes
     # explicitly; pre-axis cache entries without the fields stay warm —
-    # absent = the static vpu/native, no schema bump).  Unlike the static
-    # pick itself the twins are NOT the defended fallback, so they must
-    # pass the VMEM model — with mxu's resident band matrices / bf16's
-    # narrow pipeline planes over an f32 level ring folded in.
+    # absent = the static vpu/native/f32, no schema bump).  Unlike the
+    # static pick itself the twins are NOT the defended fallback, so they
+    # must pass the VMEM model — with the resolved variant's resident
+    # contraction constants / bf16's narrow pipeline planes over an f32
+    # level ring folded in.
     if mxu_supported([dtype]) and wavefront_vmem_fits(
         static_k, Y, Z, itemsize, mxu=True
     ):
@@ -161,6 +163,24 @@ def jacobi_wrap_space(
         )
     else:
         prefiltered += 1
+    # the band-tiled variant twin + its bf16-INPUT leg (the doubled-ratio
+    # arm of the "VPU wall" break-even model) — prefiltered when the plane
+    # geometry admits no tiling (the kernel would just re-measure dense)
+    if (
+        mxu_supported([dtype])
+        and band_tile_plan(Y, Z) is not None
+        and wavefront_vmem_fits(static_k, Y, Z, itemsize, mxu="mxu_band")
+    ):
+        kept.append(
+            {"k": static_k, "compute_unit": "mxu_band",
+             "storage_dtype": "native"}
+        )
+        kept.append(
+            {"k": static_k, "compute_unit": "mxu_band",
+             "storage_dtype": "native", "mxu_input": "bf16"}
+        )
+    else:
+        prefiltered += 2
     if bf16_supported([dtype]) and wavefront_vmem_fits(
         static_k, Y, Z, jnp.dtype(jnp.bfloat16).itemsize,
         ring_itemsize=itemsize,
@@ -181,12 +201,14 @@ def jacobi_wavefront_space(
     ms=None,
     mxu_ok: bool = False,
     bf16_ok: bool = False,
+    band_ok: bool = False,
 ) -> Tuple[List[dict], int]:
     """(candidates, prefiltered) over the multi-device wavefront: depth ``m``
     (== the halo multiplier: the m-wide shell is exchanged every m steps),
     alias on/off, and — at the static depth — z-ring vs padded layout plus
-    the compute-unit / storage-dtype A/Bs (``mxu_ok`` / ``bf16_ok`` are the
-    structural prefilters the caller evaluates: f32 compute / f32 fields).
+    the compute-unit / storage-dtype A/Bs (``mxu_ok`` / ``bf16_ok`` /
+    ``band_ok`` are the structural prefilters the caller evaluates: f32
+    compute / f32 fields / a band-tilable raw plane geometry).
     ``depth_cap`` is the structural bound (shard/valid extents)."""
     grid = sorted({static_m, *(ms if ms is not None else _DEPTH_GRID)})
     grid = [m for m in grid if 1 <= m <= depth_cap]
@@ -216,6 +238,14 @@ def jacobi_wavefront_space(
         cands.append(cand(static_m, False, static_ring, unit="mxu"))
     else:
         prefiltered += 1
+    # the band-tiled variant twin + its bf16-input leg
+    if mxu_ok and band_ok:
+        cands.append(cand(static_m, False, static_ring, unit="mxu_band"))
+        c = cand(static_m, False, static_ring, unit="mxu_band")
+        c["mxu_input"] = "bf16"
+        cands.append(c)
+    else:
+        prefiltered += 2
     if bf16_ok:
         cands.append(cand(static_m, False, static_ring, storage="bf16"))
     else:
@@ -372,8 +402,13 @@ def stream_space(dd, x_radius: int, separable: bool, static_plan: dict,
     # the compute-unit A/B: an mxu twin of the static plan, measured against
     # its vpu sibling under the same protocol (the "Break the VPU wall"
     # lever — the win depends on where the plan sits relative to the
-    # roll+add wall, so it is measured, not assumed)
+    # roll+add wall, so it is measured, not assumed), plus the band-tiled
+    # variant twin when the raw plane geometry tiles (band_tile_plan) —
+    # pre-variant cache entries (compute_unit="mxu" winners) stay warm:
+    # the value keeps its meaning and absent mxu_input = the static f32
     if mxu_ok:
+        from stencil_tpu.ops.jacobi_pallas import band_tile_plan
+
         b = {
             k: v
             for k, v in static_plan.items()
@@ -381,8 +416,14 @@ def stream_space(dd, x_radius: int, separable: bool, static_plan: dict,
         }
         add(b, static_alias if static_plan["route"] != "wrap" else None,
             unit="mxu")
+        raw = dd.local_spec().raw_size()
+        if band_tile_plan(raw.y, raw.z) is not None:
+            add(b, static_alias if static_plan["route"] != "wrap" else None,
+                unit="mxu_band")
+        else:
+            prefiltered += 1
     else:
-        prefiltered += 1
+        prefiltered += 2
     # static VMEM verdict (analysis/vmem.py): candidates whose MODELED
     # footprint busts the scoped-VMEM budget are pruned here, before the
     # search pays a compile-and-catch VMEM_OOM for them.  plan_stream

@@ -210,16 +210,24 @@ def test_bench_mxu_vs_vpu_section_schema():
     ab = bench.mxu_vs_vpu_ab(size=12, k=2, interpret=True, rt=0.0,
                              reps=1, inner=1)
     assert ab["eligible"] is True and ab["k"] == 2
+    assert ab["band_eligible"] is True  # 12 tiles at granule 3
     assert ab["measurement_protocol"]["drop_rep0"] is True
-    assert set(ab["units"]) == {"vpu", "mxu"}
+    assert set(ab["units"]) == {"vpu", "mxu", "mxu_band", "mxu_band+bf16in"}
     for entry in ab["units"].values():
         assert entry["ms_per_dispatch"] > 0
         assert entry["mcells_per_s"] > 0
-    assert ab["speedup_vs_vpu"] == pytest.approx(
-        ab["units"]["vpu"]["ms_per_dispatch"]
-        / ab["units"]["mxu"]["ms_per_dispatch"],
-        rel=1e-3,
-    )
+    assert set(ab["speedups_vs_vpu"]) == {
+        "mxu", "mxu_band", "mxu_band+bf16in",
+    }
+    for leg, sp in ab["speedups_vs_vpu"].items():
+        # both sides are independently rounded artifact fields
+        assert sp == pytest.approx(
+            ab["units"]["vpu"]["ms_per_dispatch"]
+            / ab["units"][leg]["ms_per_dispatch"],
+            abs=2e-3,
+        )
+    # the legacy scalar keeps reporting the dense ratio
+    assert ab["speedup_vs_vpu"] == ab["speedups_vs_vpu"]["mxu"]
 
 
 @pytest.mark.parametrize("backend", ["xla", "pallas"])

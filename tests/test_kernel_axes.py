@@ -23,6 +23,7 @@ import pytest
 
 from ulp import (
     assert_bf16_storage_close,
+    assert_mxu_bf16_input_close,
     assert_reassociation_close,
     assert_ulp_close,
 )
@@ -33,11 +34,17 @@ from stencil_tpu.domain import DistributedDomain
 from stencil_tpu.models.jacobi import Jacobi3D
 from stencil_tpu.ops import stream as sm
 from stencil_tpu.ops.jacobi_pallas import (
+    band_tile_plan,
+    band_tile_size,
     bf16_supported,
     jacobi_wrap_step,
+    mxu_flops_per_plane,
     mxu_supported,
     band_matrix,
+    plane_band_unit,
+    plane_nbr_sum_host,
     resolve_compute_unit,
+    resolve_mxu_input,
     resolve_storage_dtype,
 )
 from stencil_tpu.resilience import inject
@@ -639,7 +646,10 @@ def test_axis_events_and_mxu_flops_counter(tmp_path, tune_dir):
         dd.run_step(step, 2)
         f1 = telemetry.snapshot()["counters"][tm.KERNEL_MXU_FLOPS]
         raw = dd.local_spec().raw_size()
-        per_plane = 2 * raw.y * raw.y * raw.z + 2 * raw.y * raw.z * raw.z
+        # the counter models the plane the pass CONTRACTS: the z-slab
+        # wavefront lane-pads its planes to a 128 multiple
+        pz = sm.lane_pad_width(raw.z) if step._stream_plan["z_slabs"] else raw.z
+        per_plane = 2 * raw.y * raw.y * pz + 2 * raw.y * pz * pz
         assert f1 - f0 == per_plane * raw.x * 8 * 2  # shards x steps
         import json
 
@@ -648,6 +658,33 @@ def test_axis_events_and_mxu_flops_counter(tmp_path, tune_dir):
         ]
         cu = [e for e in events if e["event"] == tm.EVENT_KERNEL_COMPUTE_UNIT]
         assert cu and cu[-1]["unit"] == "mxu" and cu[-1]["source"] == "explicit"
+    finally:
+        telemetry.disable()
+
+
+def test_band_event_and_flops_counter_model_the_variant(tmp_path, tune_dir):
+    """kernel.mxu.flops under mxu_band counts the band-tiled analytic
+    model (6·g·Y·Z per axis), NOT the dense one — the dense model would
+    over-report by ~n/(2r+1) and poison every roofline/ledger series."""
+    telemetry.enable(dir=str(tmp_path))
+    telemetry.reset()
+    try:
+        dd, _ = _mk(mult=2)
+        step = dd.make_step(mean6_kernel, engine="stream", interpret=True,
+                            compute_unit="mxu_band",
+                            mxu_kernel=mean6_kernel_mxu)
+        assert step._stream_plan["compute_unit"] == "mxu_band"
+        dd.run_step(step, 2)
+        f = telemetry.snapshot()["counters"][tm.KERNEL_MXU_FLOPS]
+        raw = dd.local_spec().raw_size()
+        # modeled on the plane the pass CONTRACTS (lane-padded under the
+        # z-slab route — the padded width decides which tiling engages)
+        pz = sm.lane_pad_width(raw.z) if step._stream_plan["z_slabs"] else raw.z
+        gy, gz = band_tile_plan(raw.y, pz)
+        per_plane = 6 * gy * raw.y * pz + 6 * gz * raw.y * pz
+        assert per_plane == mxu_flops_per_plane(raw.y, pz, "mxu_band")
+        assert per_plane < mxu_flops_per_plane(raw.y, pz, "mxu")
+        assert f == per_plane * raw.x * 8 * 2  # shards x steps
     finally:
         telemetry.disable()
 
@@ -669,3 +706,325 @@ def test_storage_event_emitted(tmp_path):
         assert sd[-1]["source"] == "explicit"
     finally:
         telemetry.disable()
+
+
+# --- the band-tiled contraction variant (ISSUE 13) ---------------------------
+
+
+@pytest.mark.parametrize("r", [1, 2])
+def test_band_tile_contraction_matches_dense_and_vpu(r):
+    """The blocked (2r+1)-band form computes the SAME neighbor sum as the
+    dense circulant contraction and the roll chain, across geometries that
+    exercise sublane-granule tiles, non-8-multiple granules, and uneven
+    y/z extents — band-vs-dense is pure summation order (each element sums
+    the same 2r values per axis; zeros add exactly), so it pins in the
+    same ulp regime as the dense-vs-vpu contract."""
+    rng = np.random.default_rng(11)
+    for (Y, Z) in ((32, 256), (24, 48), (40, 120)):
+        assert band_tile_plan(Y, Z, r) is not None, (Y, Z, r)
+        c = jnp.asarray(rng.standard_normal((Y, Z)), jnp.float32)
+        vpu = np.asarray(plane_nbr_sum_host(c, "vpu", r=r))
+        dense = np.asarray(plane_nbr_sum_host(c, "mxu", r=r))
+        band = np.asarray(plane_nbr_sum_host(c, "mxu_band", r=r))
+        # operand-scale-aware bounds: the (2r+1)-band sums cross zero on
+        # this data, where result-relative ulps blow up on operand-scale
+        # reassociation divergence (the assert_reassociation_close regime)
+        scale = float(np.abs(np.asarray(c)).max()) * 4 * r
+        assert_reassociation_close(dense, vpu, rounds=4 * r, scale=scale,
+                                   context=f"dense r={r} ({Y},{Z})")
+        assert_reassociation_close(band, dense, rounds=2 * r, scale=scale,
+                                   context=f"band-vs-dense r={r} ({Y},{Z})")
+        if r == 1:
+            # sums of two values are order-independent: the band form is
+            # BITWISE the dense contraction at the face-stencil radius
+            assert_ulp_close(band, dense, ulps=0,
+                             context=f"band bitwise r=1 ({Y},{Z})")
+
+
+def test_band_tile_plan_selection_and_structural_degrade():
+    """Granule preference (smallest 8-multiple divisor, else smallest
+    admissible), prime extents degrade band->dense per plane geometry, and
+    the degraded kernel still matches vpu."""
+    assert band_tile_size(512) == 8
+    assert band_tile_size(512, r=2) == 8  # 8 >= 2r+1 = 5
+    assert band_tile_size(12) == 3  # no admissible 8-multiple; smallest >= 3
+    assert band_tile_size(24, r=2) == 6  # smallest divisor >= 5 with 3g < n
+    assert band_tile_size(14) is None  # g=7 would COST more than dense
+    assert band_tile_size(13) is None  # prime: only n itself divides
+    assert band_tile_plan(16, 13) is None  # one untilable axis kills both
+    assert plane_band_unit("mxu_band", 16, 13) == "mxu"  # degrade, not crash
+    assert plane_band_unit("mxu_band", 16, 16) == "mxu_band"
+    assert plane_band_unit("vpu", 16, 13) == "vpu"
+    # the degraded geometry still runs (dense form) and matches vpu
+    rng = np.random.default_rng(3)
+    b0 = jnp.asarray(rng.random((12, 13, 13)), jnp.float32)
+    v = jacobi_wrap_step(b0, interpret=True, k=2)
+    m = jacobi_wrap_step(b0, interpret=True, k=2, compute_unit="mxu_band")
+    assert_ulp_close(np.asarray(m), np.asarray(v),
+                     ulps=MXU_ULPS_PER_LEVEL * 2, context="degraded band")
+    # an untilable-geometry band FLOP model prices the dense form it runs
+    assert mxu_flops_per_plane(13, 13, "mxu_band") == mxu_flops_per_plane(13, 13)
+
+
+@pytest.mark.parametrize("k", [1, 3])
+def test_wrap_mxu_band_matches_dense_and_vpu(k):
+    rng = np.random.default_rng(7)
+    b0 = jnp.asarray(rng.random((12, 16, 16)), jnp.float32)
+    v = jacobi_wrap_step(b0, interpret=True, k=k)
+    d = jacobi_wrap_step(b0, interpret=True, k=k, compute_unit="mxu")
+    b = jacobi_wrap_step(b0, interpret=True, k=k, compute_unit="mxu_band")
+    assert_ulp_close(np.asarray(b), np.asarray(v),
+                     ulps=MXU_ULPS_PER_LEVEL * k, context=f"band-vs-vpu k={k}")
+    # band-vs-dense differs only by the blocked summation order: ≤1
+    # reordered rounding per level
+    assert_ulp_close(np.asarray(b), np.asarray(d), ulps=k,
+                     context=f"band-vs-dense k={k}")
+
+
+@pytest.mark.parametrize("unit", ["mxu", "mxu_band"])
+def test_wrap_bf16_input_analytic_bound(unit):
+    """bf16 MXU inputs track the f32-input form of the SAME unit within
+    the analytic operand-rounding bound (tests/ulp.mxu_bf16_input_atol) —
+    per level: 4 in-plane operand reads x one bf16 rounding each."""
+    rng = np.random.default_rng(9)
+    b0 = jnp.asarray(rng.random((12, 16, 16)), jnp.float32)
+    for k in (1, 3):
+        f32 = jacobi_wrap_step(b0, interpret=True, k=k, compute_unit=unit)
+        nar = jacobi_wrap_step(b0, interpret=True, k=k, compute_unit=unit,
+                               mxu_input="bf16")
+        assert_mxu_bf16_input_close(
+            np.asarray(nar), np.asarray(f32), levels=k, scale=1.0,
+            context=f"{unit} bf16in k={k}",
+        )
+
+
+def test_jacobi_wavefront_mxu_band_matches_vpu_uneven():
+    """The band variant on the multi-device wavefront over UNEVEN shards
+    (21³ over 8 chips pads the last shard): the plain wavefront's raw
+    planes tile at a non-8-multiple granule and the run pins against vpu;
+    the flops ledger counts the band model."""
+    a = Jacobi3D(21, 21, 21, kernel_impl="pallas", interpret=True,
+                 compute_unit="vpu")
+    a.realize()
+    b = Jacobi3D(21, 21, 21, kernel_impl="pallas", interpret=True,
+                 compute_unit="mxu_band")
+    b.realize()
+    assert a._pallas_path == b._pallas_path == "wavefront"
+    assert b._compute_unit == "mxu_band"
+    raw = b.dd.local_spec().raw_size()
+    assert band_tile_plan(raw.y, raw.z) is not None  # really band-tiled
+    assert b._mxu_flops_iter > 0
+    assert b._mxu_flops_iter < (
+        mxu_flops_per_plane(raw.y, raw.z, "mxu") * raw.x
+        * b.dd.num_subdomains()
+    )
+    a.step(4)
+    b.step(4)
+    assert_ulp_close(b.temperature(), a.temperature(),
+                     ulps=MXU_ULPS_PER_LEVEL * 4, context="wavefront band")
+
+
+def test_jacobi_wavefront_band_vs_dense_pin():
+    a = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=True,
+                 compute_unit="mxu")
+    a.realize()
+    b = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=True,
+                 compute_unit="mxu_band")
+    b.realize()
+    assert a._compute_unit == "mxu" and b._compute_unit == "mxu_band"
+    a.step(4)
+    b.step(4)
+    assert_ulp_close(b.temperature(), a.temperature(), ulps=4,
+                     context="band-vs-dense wavefront")
+
+
+def test_stream_mxu_band_matches_vpu_and_dense():
+    outs = {}
+    for unit in ("vpu", "mxu", "mxu_band"):
+        dd, hs = _mk(mult=2)
+        s = dd.make_step(mean6_kernel, engine="stream", interpret=True,
+                         compute_unit=unit, mxu_kernel=mean6_kernel_mxu)
+        assert s._stream_plan["compute_unit"] == unit
+        dd.run_step(s, 4)
+        outs[unit] = dd.quantity_to_host(hs[0])
+    assert_reassociation_close(
+        outs["mxu_band"], outs["vpu"], rounds=MXU_ULPS_PER_LEVEL * 4,
+        scale=6.0, context="stream band-vs-vpu",
+    )
+    assert_reassociation_close(
+        outs["mxu_band"], outs["mxu"], rounds=4, scale=6.0,
+        context="stream band-vs-dense",
+    )
+
+
+def test_stream_mxu_band_bf16_input_via_domain():
+    dd_a, hs_a = _mk(mult=2)
+    dd_b, hs_b = _mk(mult=2)
+    sa = dd_a.make_step(mean6_kernel, engine="stream", interpret=True,
+                        compute_unit="mxu_band",
+                        mxu_kernel=mean6_kernel_mxu)
+    sb = dd_b.make_step(mean6_kernel, engine="stream", interpret=True,
+                        compute_unit="mxu_band", mxu_input="bf16",
+                        mxu_kernel=mean6_kernel_mxu)
+    assert sa._stream_plan["mxu_input"] == "f32"
+    assert sb._stream_plan["mxu_input"] == "bf16"
+    dd_a.run_step(sa, 3)
+    dd_b.run_step(sb, 3)
+    assert_mxu_bf16_input_close(
+        dd_b.quantity_to_host(hs_b[0]), dd_a.quantity_to_host(hs_a[0]),
+        levels=3, context="stream band bf16in",
+    )
+
+
+def test_mxu_input_resolution_precedence_and_guards(monkeypatch):
+    # static
+    assert resolve_mxu_input(None, None, "mxu")[0] == "f32"
+    # env beats static; engages only under an MXU unit
+    monkeypatch.setenv("STENCIL_MXU_INPUT", "bf16")
+    assert resolve_mxu_input(None, None, "mxu_band")[0] == "bf16"
+    val, src = resolve_mxu_input(None, None, "vpu")
+    assert val == "f32" and src.endswith("/degraded")
+    # explicit beats env
+    assert resolve_mxu_input("f32", None, "mxu")[0] == "f32"
+    monkeypatch.setenv("STENCIL_MXU_INPUT", "fp8")
+    with pytest.raises(ValueError, match="STENCIL_MXU_INPUT"):
+        resolve_mxu_input(None, None, "mxu")
+    monkeypatch.delenv("STENCIL_MXU_INPUT")
+    # tuned consulted, garbage falls through to static
+    assert resolve_mxu_input(None, "bf16", "mxu")[0] == "bf16"
+    assert resolve_mxu_input(None, "fp8", "mxu")[0] == "f32"
+    with pytest.raises(ValueError, match="unknown mxu input"):
+        dd, _ = _mk()
+        dd.make_step(mean6_kernel, engine="stream", interpret=True,
+                     mxu_input="fp8")
+
+
+def test_ladder_steps_band_to_dense_to_vpu_same_depth(tune_dir):
+    """Two classified failures on an mxu_band stream rung walk the
+    contraction ladder band -> dense -> vpu at the SAME depth before any
+    depth descent, and the floor matches the vpu ground truth bitwise."""
+    dd, hs = _mk(mult=2)
+    step = dd.make_step(mean6_kernel, engine="stream", interpret=True,
+                        compute_unit="mxu_band",
+                        mxu_kernel=mean6_kernel_mxu)
+    plan0 = dict(step._stream_plan)
+    assert plan0["compute_unit"] == "mxu_band"
+    inject.set_plan("execute:vmem_oom:stream*2")
+    try:
+        dd.run_step(step, 4)
+    finally:
+        inject.set_plan(None)
+    assert step._stream_plan["compute_unit"] == "vpu"
+    assert step._stream_plan["m"] == plan0["m"]  # SAME depth throughout
+    assert [d[0] for d in step._resilience.descents] == [
+        f"{plan0['route']}[m={plan0['m']},mxu_band]",
+        f"{plan0['route']}[m={plan0['m']},mxu]",
+    ]
+    ref_dd, ref_hs = _mk(mult=2)
+    ref = ref_dd.make_step(mean6_kernel, engine="stream", interpret=True)
+    ref_dd.run_step(ref, 4)
+    np.testing.assert_array_equal(
+        ref_dd.quantity_to_host(ref_hs[0]), dd.quantity_to_host(hs[0])
+    )
+
+
+def test_jacobi_ladder_steps_band_down_to_dense(tune_dir):
+    m = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=True,
+                 compute_unit="mxu_band", temporal_k=3,
+                 devices=jax.devices()[:1])
+    m.realize()
+    assert m._compute_unit == "mxu_band" and m._wrap_k == 3
+    inject.set_plan("execute:vmem_oom:jacobi*1")
+    try:
+        m.step(3)
+    finally:
+        inject.set_plan(None)
+    assert m._compute_unit == "mxu"  # band -> dense, not straight to vpu
+    assert m._wrap_k == 3  # depth untouched
+    ref = Jacobi3D(24, 24, 24, kernel_impl="pallas", interpret=True,
+                   temporal_k=3, devices=jax.devices()[:1])
+    ref.realize()
+    ref.step(3)
+    assert_ulp_close(m.temperature(), ref.temperature(),
+                     ulps=MXU_ULPS_PER_LEVEL * 3, context="post-band-descent")
+
+
+def test_spaces_grow_band_twins_no_schema_bump(tune_dir):
+    from stencil_tpu.tune import space as tune_space
+
+    # wrap space: band twin + its bf16-input leg at the static depth
+    cands, _ = tune_space.jacobi_wrap_space((64, 64, 64), 4, 4)
+    band = [c for c in cands if c["compute_unit"] == "mxu_band"]
+    assert len(band) == 2
+    assert {c.get("mxu_input", "f32") for c in band} == {"f32", "bf16"}
+    # wavefront space: gated by band_ok
+    cands, pre = tune_space.jacobi_wavefront_space(
+        2, 4, False, False, mxu_ok=True, bf16_ok=True, band_ok=True)
+    assert [c for c in cands if c["compute_unit"] == "mxu_band"]
+    cands2, pre2 = tune_space.jacobi_wavefront_space(
+        2, 4, False, False, mxu_ok=True, bf16_ok=True, band_ok=False)
+    assert not [c for c in cands2 if c["compute_unit"] == "mxu_band"]
+    assert pre2 >= pre + 2
+    # stream space: the band twin of the static plan
+    dd, _ = _mk(mult=2)
+    with tune.disabled():
+        static = sm.plan_stream(dd, 1, "auto", False)
+    scands, _ = tune_space.stream_space(dd, 1, False, static, mxu_ok=True)
+    assert [c for c in scands if c["compute_unit"] == "mxu_band"]
+
+
+def test_tuned_mxu_band_and_input_consulted_no_schema_bump(tune_dir):
+    """A persisted compute_unit=mxu_band / mxu_input=bf16 winner is
+    consulted by the next auto build; garbage mxu_input invalidates to
+    the static plan; pre-variant entries stay warm (covered by
+    test_pre_axis_cache_entry_without_fields_still_hits)."""
+    dd, _ = _mk(mult=2)
+    key = dd.tune_key("stream")
+    tune.record_config(
+        key,
+        {"route": "wavefront", "m": 2, "z_slabs": False, "grouping": "joint",
+         "compute_unit": "mxu_band", "mxu_input": "bf16",
+         "halo_multiplier": 2},
+    )
+    tune.reset_memo()
+    dd2, _ = _mk(mult=2)
+    step = dd2.make_step(mean6_kernel, engine="stream", interpret=True,
+                         mxu_kernel=mean6_kernel_mxu)
+    assert step._stream_plan["compute_unit"] == "mxu_band"
+    assert step._stream_plan["mxu_input"] == "bf16"
+    dd2.run_step(step, 2)
+    # garbage mxu_input -> the static plan, never a crash
+    tune.record_config(
+        key,
+        {"route": "wavefront", "m": 2, "z_slabs": False, "grouping": "joint",
+         "mxu_input": "fp8", "halo_multiplier": 2},
+    )
+    tune.reset_memo()
+    dd3, _ = _mk(mult=2)
+    step3 = dd3.make_step(mean6_kernel, engine="stream", interpret=True,
+                          mxu_kernel=mean6_kernel_mxu)
+    assert step3._stream_plan["z_slabs"]  # the static plan applied
+    assert step3._stream_plan["mxu_input"] == "f32"
+
+
+def test_band_vmem_model_prices_tiles_not_circulants():
+    """The band variant's VMEM term is the KB-scale wide tiles: a budget
+    that rejects the dense mxu twin admits the band twin at the same
+    depth — the 'previously VMEM-pruned mxu candidates become admissible'
+    claim, checked through the shared models."""
+    from stencil_tpu.analysis import vmem as avmem
+    from stencil_tpu.ops.jacobi_pallas import (
+        mxu_vmem_extra_bytes,
+        wavefront_vmem_bytes,
+    )
+
+    Y = Z = 512
+    dense = mxu_vmem_extra_bytes(Y, Z, "mxu")
+    band = mxu_vmem_extra_bytes(Y, Z, "mxu_band")
+    assert band < dense // 100  # KBs vs MBs
+    assert mxu_vmem_extra_bytes(Y, Z, "mxu", "bf16") < dense
+    assert wavefront_vmem_bytes(8, Y, Z, 4, mxu="mxu_band") < \
+        wavefront_vmem_bytes(8, Y, Z, 4, mxu=True)
+    e_band = avmem.stream_plan_vmem_bytes(4, Y, Z, [4], mxu="mxu_band")
+    e_dense = avmem.stream_plan_vmem_bytes(4, Y, Z, [4], mxu=True)
+    assert e_band < e_dense

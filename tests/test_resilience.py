@@ -423,6 +423,7 @@ class TestLadderEngines:
         assert step._stream_plan == {
             "route": "wavefront", "m": 3, "z_slabs": True, "grouping": "joint",
             "overlap": "off", "halo": "array", "compute_unit": "vpu",
+            "mxu_input": "f32",
         }
         inject.set_plan("execute:vmem_oom:stream*2")
         dd.run_step(step, 4)

@@ -364,6 +364,7 @@ def test_stream_separable_per_field_grouping(monkeypatch):
     assert step._stream_plan == {
         "route": "wavefront", "m": 3, "z_slabs": True, "grouping": "per-field",
         "overlap": "off", "halo": "array", "compute_unit": "vpu",
+        "mxu_input": "f32",
     }
     monkeypatch.delenv("STENCIL_VMEM_LIMIT_BYTES")
     ref_dd, ref_hs = _mk(24, 24, 24, Radius.constant(1), names, devs)
@@ -430,6 +431,7 @@ def test_stream_depth_cap():
     assert step._stream_plan == {
         "route": "wrap", "m": 8, "z_slabs": False, "grouping": "joint",
         "overlap": "off", "halo": "array", "compute_unit": "vpu",
+        "mxu_input": "f32",
     }
     for a, b in outs:  # uncapped wrap vs the XLA ground truth
         np.testing.assert_allclose(a, b, **TOL)

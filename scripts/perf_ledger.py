@@ -6,8 +6,10 @@ Thin shim over ``stencil_tpu/telemetry/ledger.py`` (jax-free):
     # normalize artifacts into the append-only ledger (idempotent);
     # bench_exchange route-A/B JSON lines (saved to a file) land as their
     # own exchange_ab:* series, so packed-route wins are regression-gated
-    # like the headline numbers
-    python scripts/perf_ledger.py ingest BENCH_*.json weak_scaling_out/weak_scaling_summary.json exchange_ab.json
+    # like the headline numbers; soak_summary.json artifacts land as the
+    # LOWER-is-better `reshard:seconds` / `soak:recovery_seconds` series
+    # (the gate flags rises there, not drops)
+    python scripts/perf_ledger.py ingest BENCH_*.json weak_scaling_out/weak_scaling_summary.json exchange_ab.json soak_out/soak_summary.json
 
     # the regression gate: newest value per series vs its trailing median
     python scripts/perf_ledger.py check --threshold 0.1 --window 5

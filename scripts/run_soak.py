@@ -19,13 +19,24 @@ The acceptance harness for the long-run survival layer
 3. The final manifests must match DIGEST-FOR-DIGEST: a resumed run's
    fields are bitwise identical to the unkilled run's.
 
-``--dryrun`` forces the CPU backend with one fake device (like
-``run_weak_scaling.py``) so the whole chaos story runs on any machine;
-without it the driver uses the host's real devices.  A
-``soak_summary.json`` artifact records every kill, resume, and the final
-verdict.
+``--reshard`` additionally seeds ELASTIC-CAPACITY transitions into the
+chaos run (docs/resilience.md "Elastic capacity"): the ``shrink``/``grow``
+fault hooks make the supervisor drain and reshard the live domain in
+memory (``DistributedDomain.reshard`` — no disk round trip) at >= 2
+seeded points, interleaved with the kills.  The digest comparison then
+pins bitwise continuity ACROSS mesh transitions as well as kills, and
+``soak_summary.json`` records every transition with its in-memory reshard
+seconds (``scripts/perf_ledger.py`` ingests them as the regression-gated
+``reshard:seconds`` / ``soak:recovery_seconds`` series).
+
+``--dryrun`` forces the CPU backend with one fake device (two under
+``--reshard`` — a mesh must have somewhere to shrink from) so the whole
+chaos story runs on any machine; without it the driver uses the host's
+real devices.  A ``soak_summary.json`` artifact records every kill,
+resume, transition, and the final verdict.
 
     python scripts/run_soak.py --dryrun
+    python scripts/run_soak.py --dryrun --reshard
 
 The in-process tier-1 twin of this harness (one kill point, no
 subprocesses) is ``tests/test_supervisor.py``.
@@ -76,8 +87,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--dryrun",
         action="store_true",
-        help="CPU backend with 1 fake device — exercises the whole chaos "
-        "story anywhere (numbers are not perf)",
+        help="CPU backend with 1 fake device (2 with --reshard) — "
+        "exercises the whole chaos story anywhere (numbers are not perf)",
+    )
+    p.add_argument(
+        "--reshard",
+        action="store_true",
+        help="seed >= 2 elastic-capacity transitions (shrink/grow fault "
+        "hooks -> in-memory drain-and-reshard) into the chaos run, "
+        "interleaved with the kills; bitwise continuity must hold across "
+        "mesh transitions too",
     )
     return p
 
@@ -122,7 +141,11 @@ def driver_env(args, fault_plan: str = "") -> dict:
             for f in env.get("XLA_FLAGS", "").split()
             if "xla_force_host_platform_device_count" not in f
         )
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=1").strip()
+        # --reshard needs a mesh with somewhere to shrink from
+        n_dev = 2 if args.reshard else 1
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_dev}"
+        ).strip()
         env["JAX_PLATFORMS"] = "cpu"
     return env
 
@@ -155,6 +178,17 @@ def ring_progress(ckpt_dir: str) -> int:
     return entries[-1][0] if entries else 0
 
 
+def harvest_transitions(ckpt_dir: str) -> list:
+    """Mesh transitions recorded by the LAST driver process's flight
+    recorder (each process heartbeats its own in-memory history into the
+    checkpoint dir's status.json; read right after the launch, before the
+    next process overwrites it)."""
+    from stencil_tpu.telemetry.flight import read_status
+
+    status = read_status(ckpt_dir) or {}
+    return list(status.get("mesh_history") or [])
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.iters < args.kills + 2:
@@ -181,10 +215,14 @@ def main(argv=None) -> int:
     ref = final_manifest(ref_dir)
     assert ref["step"] == args.iters, (ref["step"], args.iters)
 
+    import time as _time
+
     rng = random.Random(args.seed)
     kills = []
+    transitions = []
     progress = 0
     launches = 0
+    chaos_t0 = _time.monotonic()
     for i in range(args.kills):
         # a seeded dispatch AHEAD of current progress, strictly before the
         # end so there is always work left to resume; alternate the signal
@@ -193,6 +231,30 @@ def main(argv=None) -> int:
         offset = rng.randrange(0, max(remaining - 1, 1))
         sig = "sigkill" if i % 2 == 0 else "sigterm"
         plan = f"dispatch:{sig}:jacobi@{offset}"
+        capacity = []
+        if args.reshard and i < 2:
+            # seed capacity transitions STRICTLY before this launch's kill:
+            # launch 0 shrinks then grows back in one process (both
+            # directions through the live drain-and-reshard path), launch 1
+            # shrinks and dies shrunken (the elastic restore of the NEXT
+            # launch re-fits the checkpoint onto the full mesh).  Every
+            # relaunch starts at full capacity, so shrink always engages.
+            # Each capacity FIRING shifts the kill by one dispatch (fire()
+            # returns at the first firing entry, so the kill entry's skip
+            # counter doesn't see those calls) — the clamp must leave room
+            # for offset + n_cap to land strictly before the end.
+            n_cap = 2 if i == 0 else 1
+            offset = min(max(offset, 3), max(remaining - 2 - n_cap, 1))
+            capacity = (
+                ["shrink@0", "grow@1"]
+                if i == 0
+                else [f"shrink@{max(offset - 2, 0)}"]
+            )
+            plan = ",".join(
+                [f"dispatch:{c.split('@')[0]}:jacobi@{c.split('@')[1]}" for c in capacity]
+                + [f"dispatch:{sig}:jacobi@{offset}"]
+            )
+            offset += n_cap  # the EFFECTIVE kill dispatch (recorded below)
         print(
             f"== chaos kill {i + 1}/{args.kills}: {sig} at dispatch "
             f"{progress}+{offset} (plan {plan!r})",
@@ -211,12 +273,15 @@ def main(argv=None) -> int:
             )
         if expected is not None and rc != expected:
             raise SystemExit(f"kill {i + 1}: sigterm run exited rc={rc}, want {expected}")
+        if args.reshard:
+            transitions.extend(harvest_transitions(chaos_dir))
         new_progress = ring_progress(chaos_dir)
         kills.append(
             {
                 "kill": i + 1,
                 "signal": sig,
                 "at_dispatch": progress + offset,
+                "capacity_hooks": capacity,
                 "rc": rc,
                 "checkpointed_step": new_progress,
             }
@@ -229,11 +294,22 @@ def main(argv=None) -> int:
         flight.heartbeat(progress, args.iters, stage="resume", launches=launches)
         rc = launch(args, chaos_dir, resume=True)
         launches += 1
+        if args.reshard:
+            transitions.extend(harvest_transitions(chaos_dir))
         if rc == 0:
             break
         progress = ring_progress(chaos_dir)
         if launches > args.max_launches:
             raise SystemExit(f"no clean completion after {launches} launches")
+    recovery_seconds = _time.monotonic() - chaos_t0
+    reshard_seconds = [
+        t["seconds"] for t in transitions if t.get("kind") == "reshard"
+    ]
+    if args.reshard and len(reshard_seconds) < 2:
+        raise SystemExit(
+            f"--reshard soak completed only {len(reshard_seconds)} in-memory "
+            f"transitions (< 2); transitions seen: {transitions}"
+        )
 
     chaos = final_manifest(chaos_dir)
     ref_digests = {q["name"]: q["digest"] for q in ref["quantities"]}
@@ -243,11 +319,19 @@ def main(argv=None) -> int:
     summary = {
         "bench": "soak_kill_resume",
         "dryrun": bool(args.dryrun),
+        "reshard": bool(args.reshard),
         "iters": args.iters,
         "checkpoint_every": args.checkpoint_every,
         "seed": args.seed,
         "kills": kills,
         "launches": launches,
+        # per-transition in-memory reshard timings + the chaos-phase wall
+        # clock: scripts/perf_ledger.py ingests these as the
+        # regression-gated (lower-is-better) `reshard:seconds` and
+        # `soak:recovery_seconds` series
+        "transitions": transitions,
+        "reshard_seconds": reshard_seconds,
+        "recovery_seconds": round(recovery_seconds, 3),
         "final_step": {"ref": ref["step"], "chaos": chaos["step"]},
         "digests": {"ref": ref_digests, "chaos": chaos_digests},
         "bitwise_identical": identical,
@@ -271,8 +355,13 @@ def main(argv=None) -> int:
         print("FAIL: resumed fields differ from the unkilled run", file=sys.stderr)
         return 1
     print(
-        f"OK: {args.kills} kills, {launches} launches, fields bitwise "
-        f"identical to the unkilled run ({path})",
+        f"OK: {args.kills} kills, {launches} launches"
+        + (
+            f", {len(reshard_seconds)} in-memory mesh transitions"
+            if args.reshard
+            else ""
+        )
+        + f", fields bitwise identical to the unkilled run ({path})",
         file=sys.stderr,
     )
     return 0

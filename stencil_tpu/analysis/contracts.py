@@ -19,6 +19,13 @@ walker or a source-level heuristic the tracer can defeat:
   write — no partial-window update on a raw-shaped array, no blend/unpack
   kernel consuming a (big array, thin slab) pair; the shell data flows
   message → VMEM patch → pass output only.
+* ``redistribute-bounded`` — the elastic-capacity collective's headline
+  claim (``parallel/redistribute.py``, per arxiv 2112.01075): the traced
+  redistribution program moves shard-sized staging buffers through
+  permutation rounds — every intermediate inside the shard-mapped body
+  stays under a constant multiple of the shard size, and no gathering
+  collective (all_gather / all_to_all) appears anywhere.  A full-gather
+  "redistribution" would pass every numeric test and OOM only at scale.
 * ``donation-soundness``  — the jaxpr-level twin of the ``donated-reuse``
   lint rule: a donated/aliased buffer must be dead after the call.
 * ``accum-dtype``         — every contraction in a kernel jaxpr pins an
@@ -245,6 +252,13 @@ class SliverDus(Contract):
         "source rule cannot see through helpers (PERF_NOTES probe6)"
     )
 
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        # the redistribution schedule writes staging windows whose extents
+        # are whatever the mesh intersection yields — a one-shot capacity
+        # transition, not a per-step hot path; its own contract
+        # (redistribute-bounded) checks what actually matters there
+        return art.kind != "redistribute"
+
     def check(self, art: ProgramArtifact) -> List[Finding]:
         from stencil_tpu.analysis import jaxpr as jx
 
@@ -368,6 +382,110 @@ class FusedHalo(Contract):
                             "never back in the big array",
                         )
                     )
+        return out
+
+
+#: collectives that materialize gathered state — the exact failure mode
+#: the bounded redistribution schedule exists to avoid
+_GATHERING_PRIMITIVES = frozenset(
+    {"all_gather", "all_gather_invariant", "all_to_all"}
+)
+
+
+def _aval_nbytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * dtype.itemsize
+
+
+@register
+class RedistributeBounded(Contract):
+    name = "redistribute-bounded"
+    why = (
+        "the traced redistribution program moves bounded staging buffers "
+        "through ppermute rounds: every intermediate inside the "
+        "shard-mapped body stays under meta['bound_bytes'] (a constant "
+        "multiple of the shard size) and no gathering collective appears — "
+        "a full-gather reshard passes every numeric test and OOMs at scale "
+        "(parallel/redistribute.py, arxiv 2112.01075)"
+    )
+
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        return art.kind == "redistribute"
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import jaxpr as jx
+        from stencil_tpu.parallel.redistribute import STAGING_BOUND_FACTOR
+
+        out: List[Finding] = []
+        bound = art.meta.get("bound_bytes")
+        if not isinstance(bound, int) or bound <= 0:
+            return [
+                art.finding(
+                    self.name,
+                    "redistribute artifact carries no meta['bound_bytes'] — "
+                    "the staging bound cannot be verified",
+                )
+            ]
+        for e in jx.iter_eqns(art.closed):
+            if e.primitive.name in _GATHERING_PRIMITIVES:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"{e.primitive.name} (scope "
+                        f"{jx.name_stack_str(e)!r}) — a gathering collective "
+                        "in a redistribution program materializes more than "
+                        "the bounded staging schedule allows",
+                    )
+                )
+        bodies = [
+            sub
+            for e in jx.iter_eqns(art.closed)
+            if e.primitive.name == "shard_map"
+            for sub in jx.eqn_subjaxprs(e)
+        ]
+        if not bodies:
+            return out + [
+                art.finding(
+                    self.name,
+                    "redistribution program traced no shard_map body — the "
+                    "per-chip memory bound has nothing to hold against",
+                )
+            ]
+        saw_permute = False
+        for body in bodies:
+            for j in jx.walk(body):
+                for e in j.eqns:
+                    if e.primitive.name == "ppermute":
+                        saw_permute = True
+                    for v in e.outvars:
+                        nb = _aval_nbytes(getattr(v, "aval", None))
+                        if nb > bound:
+                            out.append(
+                                art.finding(
+                                    self.name,
+                                    f"{e.primitive.name} (scope "
+                                    f"{jx.name_stack_str(e)!r}) materializes "
+                                    f"a {nb}-byte intermediate inside the "
+                                    f"shard-mapped body (> the "
+                                    f"{bound}-byte staging bound, "
+                                    f"{STAGING_BOUND_FACTOR}x the shard) — "
+                                    "the schedule is not memory-bounded",
+                                )
+                            )
+        if art.meta.get("union_ranks", 2) > 1 and not saw_permute:
+            out.append(
+                art.finding(
+                    self.name,
+                    "multi-rank redistribution program issues no ppermute — "
+                    "nothing actually moves through the collective schedule",
+                )
+            )
         return out
 
 

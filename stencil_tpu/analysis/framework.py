@@ -58,6 +58,8 @@ class ProgramArtifact:
     ``n_devices`` — mesh size the program was built for (1 = no exchange).
     ``vmem_budget`` — budget override in bytes for the vmem contract
                  (fixtures pin tiny budgets without touching the env).
+    ``meta``   — free-form build facts for kind-specific contracts (the
+                 redistribution programs carry their staging bound here).
     """
 
     label: str
@@ -68,6 +70,7 @@ class ProgramArtifact:
     dd: object = None
     n_devices: int = 1
     vmem_budget: Optional[int] = None
+    meta: dict = dataclasses.field(default_factory=dict)
 
     def finding(self, contract: str, message: str) -> Finding:
         return Finding(contract=contract, program=self.label, message=message)

@@ -64,7 +64,7 @@ class ProgramSpec:
     """One canonical program: what to build and which axes it exercises."""
 
     label: str
-    kind: str = "step"  # "step" | "exchange"
+    kind: str = "step"  # "step" | "exchange" | "redistribute"
     size: tuple = (16, 16, 16)
     n_devices: int = MATRIX_DEVICES
     halo_mult: int = 1
@@ -76,6 +76,7 @@ class ProgramSpec:
     compute_unit: str = "vpu"
     mxu_input: str = "f32"
     storage_dtype: str = "native"
+    reshard_to: tuple = ()  # redistribute only: the target mesh dim
 
     @property
     def axes(self) -> dict:
@@ -188,6 +189,18 @@ CANONICAL_PROGRAMS: List[ProgramSpec] = [
         halo_mult=2,
         exchange_route="yzpack_pallas",
     ),
+    # the elastic-capacity collective (parallel/redistribute.py): a shrink
+    # of an UNEVEN halo-multiplier domain from the full 8-chip mesh onto 4
+    # chips — the redistribute-bounded contract holds its staging bound
+    # and no-gather claim on the really-planned schedule (uneven shards
+    # and mult-2 shells give the chunk decomposition its hardest shapes)
+    ProgramSpec(
+        "redistribute:2x2x2->2x2x1/uneven",
+        kind="redistribute",
+        size=(17, 17, 17),
+        halo_mult=2,
+        reshard_to=(2, 2, 1),
+    ),
 ]
 
 
@@ -264,10 +277,52 @@ def _build_domain(spec: ProgramSpec):
     return dd
 
 
+def _redistribute_artifact(spec: ProgramSpec, dd) -> ProgramArtifact:
+    """Trace the really-planned redistribution schedule source mesh ->
+    ``spec.reshard_to`` (the exact jitted program ``DistributedDomain.
+    reshard`` dispatches), with the staging bound in ``meta``."""
+    import jax
+
+    from stencil_tpu.core.radius import Radius
+    from stencil_tpu.domain import DistributedDomain
+    from stencil_tpu.parallel.redistribute import (
+        SideGeometry,
+        plan_redistribution,
+        redistribution_program,
+    )
+
+    n_target = 1
+    for v in spec.reshard_to:
+        n_target *= v
+    tgt = DistributedDomain(*spec.size)
+    tgt.set_radius(Radius.constant(1))
+    tgt.set_devices(jax.devices()[:n_target])
+    tgt.set_partition(*spec.reshard_to)
+    if spec.halo_mult > 1:
+        tgt.set_halo_multiplier(spec.halo_mult)
+    tgt.realize(allocate=False)  # geometry only — the plan needs no arrays
+    plan = plan_redistribution(
+        tuple(spec.size),
+        SideGeometry.of_domain(dd),
+        SideGeometry.of_domain(tgt),
+    )
+    fn, example, meta = redistribution_program(plan)
+    closed = jax.make_jaxpr(fn)(example)
+    return ProgramArtifact(
+        label=spec.label,
+        kind="redistribute",
+        closed=closed,
+        n_devices=len(plan.union_devices),
+        meta=meta,
+    )
+
+
 def build_program(spec: ProgramSpec) -> ProgramArtifact:
     """Really build and trace one canonical program (interpret/CPU mode)."""
     with tpu_shaped_trace():
         dd = _build_domain(spec)
+        if spec.kind == "redistribute":
+            return _redistribute_artifact(spec, dd)
         if spec.kind == "exchange":
             fn = dd.make_exchange_route_fn(spec.exchange_route, donate=False)
             return trace_artifact(

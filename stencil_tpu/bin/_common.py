@@ -315,10 +315,13 @@ def add_checkpoint_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
-def supervisor_for(args, dd, label: str, run_state=None):
+def supervisor_for(args, dd, label: str, run_state=None, on_mesh_change=None):
     """A configured ``RunSupervisor`` from ``add_checkpoint_flags``'s
     choices (environment knobs fill unset flags), or None when no
-    checkpoint dir is configured anywhere — supervision is opt-in."""
+    checkpoint dir is configured anywhere — supervision is opt-in.
+    ``on_mesh_change`` is the elastic-capacity rebuild hook (the models'
+    ``rebuild_after_reshard``): called after a drain-and-reshard or a
+    cross-mesh restore so steps closed over the old mesh are re-traced."""
     from stencil_tpu.resilience.supervisor import RunSupervisor, SupervisorConfig
 
     overrides = {}
@@ -331,7 +334,10 @@ def supervisor_for(args, dd, label: str, run_state=None):
     )
     if cfg is None:
         return None
-    return RunSupervisor(dd, cfg, label=label, run_state=run_state)
+    return RunSupervisor(
+        dd, cfg, label=label, run_state=run_state,
+        on_mesh_change=on_mesh_change,
+    )
 
 
 def tune_begin(args) -> None:

@@ -149,6 +149,7 @@ def _run(args) -> int:
     sup = _common.supervisor_for(
         args, sim.dd, label="astaroth",
         run_state=lambda: {"model": "astaroth", "quantities": args.quantities},
+        on_mesh_change=sim.rebuild_after_reshard,
     )
     rc = 0
     if sup is not None:

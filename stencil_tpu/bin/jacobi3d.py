@@ -198,6 +198,9 @@ def _run(args) -> int:
             "compute_unit": model._compute_unit,
             "iters": args.iters,
         },
+        # elastic capacity: a drain-and-reshard (or cross-mesh restore)
+        # re-traces the step for the new geometry
+        on_mesh_change=model.rebuild_after_reshard,
     )
     mult = args.halo_multiplier
     dispatch_index = [0]

@@ -426,6 +426,50 @@ class DistributedDomain:
     def enable_exchange_stats(self, on: bool = True) -> None:
         self._exchange_stats = on
 
+    def _derive_geometry(self, devices):
+        """Mesh/placement/spec for THIS domain over ``devices`` — the one
+        place the padded-equal-split geometry (and its admissibility
+        checks) is computed, shared by ``realize()`` and the reshard
+        target planning so the two can never drift."""
+        mesh, placement = make_mesh(
+            self._size, self._radius, devices, self._strategy,
+            force_dim=self._force_dim,
+        )
+        dim = placement.dim()
+        # uneven sizes: pad each axis's shard to ceil(size/dim) and mask (the
+        # reference's +-1-cell remainders, partition.hpp:83-114; XLA shards
+        # must be equal).  The LAST shard on a padded axis owns
+        # ``size - (dim-1)*n_pad`` valid cells.
+        n = Dim3(*(-(-self._size[ax] // dim[ax]) for ax in range(3)))
+        vlast = []
+        for ax in range(3):
+            v = self._size[ax] - (dim[ax] - 1) * n[ax]
+            vlast.append(None if v == n[ax] else v)
+        # the SHELL radius is the user radius times the halo multiplier: the
+        # allocation, the exchange, and the bytes model all use it; compute
+        # sub-steps shrink by the user radius
+        r = self._radius.scaled(self._halo_mult)
+        max_r = max(r.lo().x, r.lo().y, r.lo().z, r.hi().x, r.hi().y, r.hi().z)
+        min_valid = min(v if v is not None else n[ax] for ax, v in enumerate(vlast))
+        if min_valid <= 0:
+            # pad-and-mask confines the remainder to ONE trailing shard; a
+            # split where (dim-1)*ceil(size/dim) >= size (e.g. 10 cells over
+            # 8 shards) leaves the last shard empty.  The reference spreads
+            # +-1-cell remainders across shards instead (partition.hpp:83-114)
+            # — that scheme has no equal-shard analog, so reject explicitly.
+            raise ValueError(
+                f"axis remainder does not fit in one trailing shard: size "
+                f"{self._size} over mesh {dim} gives last-shard valid cells "
+                f"{vlast}; choose a mesh dim with (dim-1)*ceil(size/dim) < size"
+            )
+        if min(n.x, n.y, n.z) < max_r or min_valid < max_r:
+            raise ValueError(
+                f"subdomain {n} (last-shard valid {vlast}) smaller than radius shell"
+            )
+        # all shards share one spec (padded equal split); per-shard origin varies
+        spec = LocalSpec.make(n, Dim3(0, 0, 0), r)
+        return mesh, placement, spec, tuple(vlast), r
+
     def realize(self, allocate: bool = True) -> None:
         """``allocate=False`` sets up mesh/placement/geometry WITHOUT creating
         arrays or compiling the exchange — for AOT work over device-less
@@ -453,44 +497,15 @@ class DistributedDomain:
         devices = list(self._devices) if self._devices is not None else jax.devices()
         self.stats.time_topo = time.perf_counter() - t0
         t0 = time.perf_counter()
-        self.mesh, self.placement = make_mesh(
-            self._size, self._radius, devices, self._strategy, force_dim=self._force_dim
-        )
+        (
+            self.mesh,
+            self.placement,
+            self._spec,
+            self._valid_last,
+            self._shell_radius,
+        ) = self._derive_geometry(devices)
         self.stats.time_placement = time.perf_counter() - t0
         dim = self.placement.dim()
-        # uneven sizes: pad each axis's shard to ceil(size/dim) and mask (the
-        # reference's +-1-cell remainders, partition.hpp:83-114; XLA shards
-        # must be equal).  The LAST shard on a padded axis owns
-        # ``size - (dim-1)*n_pad`` valid cells.
-        n = Dim3(*(-(-self._size[ax] // dim[ax]) for ax in range(3)))
-        vlast = []
-        for ax in range(3):
-            v = self._size[ax] - (dim[ax] - 1) * n[ax]
-            vlast.append(None if v == n[ax] else v)
-        self._valid_last = tuple(vlast)
-        # the SHELL radius is the user radius times the halo multiplier: the
-        # allocation, the exchange, and the bytes model all use it; compute
-        # sub-steps shrink by the user radius
-        r = self._shell_radius = self._radius.scaled(self._halo_mult)
-        max_r = max(r.lo().x, r.lo().y, r.lo().z, r.hi().x, r.hi().y, r.hi().z)
-        min_valid = min(v if v is not None else n[ax] for ax, v in enumerate(vlast))
-        if min_valid <= 0:
-            # pad-and-mask confines the remainder to ONE trailing shard; a
-            # split where (dim-1)*ceil(size/dim) >= size (e.g. 10 cells over
-            # 8 shards) leaves the last shard empty.  The reference spreads
-            # +-1-cell remainders across shards instead (partition.hpp:83-114)
-            # — that scheme has no equal-shard analog, so reject explicitly.
-            raise ValueError(
-                f"axis remainder does not fit in one trailing shard: size "
-                f"{self._size} over mesh {dim} gives last-shard valid cells "
-                f"{vlast}; choose a mesh dim with (dim-1)*ceil(size/dim) < size"
-            )
-        if min(n.x, n.y, n.z) < max_r or min_valid < max_r:
-            raise ValueError(
-                f"subdomain {n} (last-shard valid {vlast}) smaller than radius shell"
-            )
-        # all shards share one spec (padded equal split); per-shard origin varies
-        self._spec = LocalSpec.make(n, Dim3(0, 0, 0), r)
         raw = self._spec.raw_size()
         sharding = NamedSharding(self.mesh, P(*MESH_AXES))
         gshape = (dim.x * raw.x, dim.y * raw.y, dim.z * raw.z)
@@ -525,7 +540,7 @@ class DistributedDomain:
                 if self._methods == MethodFlags.AllGather
                 else make_exchange_fn_rollcompare
             )
-            self._exchange_fn = maker(self.mesh, r, self._spec, dim)
+            self._exchange_fn = maker(self.mesh, self._shell_radius, self._spec, dim)
             self._exchange_route = "direct"  # the debug oracles have no z route
             self.stats.time_plan = time.perf_counter() - t0
             # eager trace+compile of the exchange — the analog of the
@@ -560,6 +575,188 @@ class DistributedDomain:
             label=label,
             seconds=round(self.stats.time_create, 6),
         )
+
+    def mesh_dim(self) -> Tuple[int, int, int]:
+        """The current mesh extent as a plain tuple (heartbeat/telemetry)."""
+        d = self.placement.dim()
+        return (d.x, d.y, d.z)
+
+    # --- elastic capacity ------------------------------------------------------
+
+    def reshard(self, devices=None, force_dim=None, source: str = "request") -> dict:
+        """Live mesh transition: move the realized interior state onto a
+        new device mesh IN MEMORY — the on-device generalization of
+        checkpoint-elastic-restore (docs/resilience.md "Elastic capacity").
+
+        The interiors travel as a schedule of portable collectives
+        (``parallel/redistribute.py``, per arxiv 2112.01075) with peak
+        per-chip memory bounded by a constant number of shard-sized staging
+        buffers — never a full gather — at the STORED dtype, so the result
+        is bitwise-identical to a checkpoint-elastic-restore round trip.
+        Afterward the domain is fully re-realized for the new geometry:
+        fresh exchange plan/executable (route re-resolved — the tuner is
+        consulted under the new mesh's workload key), zeroed ``next`` slot,
+        zeroed shells (exactly ``set_quantity``'s scatter), reset analytic
+        counters.  Steps built by ``make_step`` close over the OLD mesh and
+        must be rebuilt by the caller (the supervisor's ``on_mesh_change``
+        hook does this for supervised runs).
+
+        Raises :class:`~stencil_tpu.parallel.redistribute.ReshardImpossibleError`
+        when redistribution is structurally impossible (no admissible
+        partition on the target devices, source buffers already consumed) —
+        the supervisor answers that with the checkpoint-elastic-restore
+        fallback.  Returns a stats dict (seconds/bytes/from_mesh/to_mesh).
+        """
+        from stencil_tpu.parallel.redistribute import (
+            ReshardImpossibleError,
+            SideGeometry,
+            plan_redistribution,
+            redistribute_array,
+        )
+        from stencil_tpu.resilience.retry import buffers_live
+
+        assert self._realized, "reshard() needs a realized domain"
+        t0 = time.perf_counter()
+        if self._methods in (MethodFlags.AllGather, MethodFlags.RollCompare):
+            raise ReshardImpossibleError(
+                "debug exchange methods do not support live resharding"
+            )
+        if self._handles and not self._curr:
+            raise ReshardImpossibleError(
+                "domain was realized without allocation — nothing to move"
+            )
+        if self._handles and not buffers_live(self._curr):
+            raise ReshardImpossibleError(
+                "a donated source buffer was already consumed mid-dispatch; "
+                "redistribution has nothing to read — fall back to "
+                "checkpoint-elastic-restore"
+            )
+        devices = list(devices) if devices is not None else jax.devices()
+        # the new force_dim is pinned only while deriving the TARGET
+        # geometry, then restored until the install point below: a failure
+        # anywhere before installation (inadmissible partition, an error
+        # mid-collective) must leave the domain — including a
+        # set_partition pin — exactly as it was
+        old_force = self._force_dim
+        new_force = Dim3.of(force_dim) if force_dim is not None else None
+        self._force_dim = new_force
+        try:
+            try:
+                mesh, placement, spec, vlast, shell = self._derive_geometry(devices)
+            except ValueError as e:
+                raise ReshardImpossibleError(
+                    f"no admissible partition on the target devices: {e}"
+                ) from e
+        finally:
+            self._force_dim = old_force
+        src_geom = SideGeometry.of_domain(self)
+        raw = spec.raw_size()
+        lo = shell.lo()
+        dim = placement.dim()
+        dst_geom = SideGeometry(
+            dim=(dim.x, dim.y, dim.z),
+            n=tuple(spec.sz),
+            raw=(raw.x, raw.y, raw.z),
+            lo=(lo.x, lo.y, lo.z),
+            valid_last=vlast,
+            devices=tuple(mesh.devices.flat),
+        )
+        plan = plan_redistribution(tuple(self._size), src_geom, dst_geom)
+        new_curr: Dict[str, jax.Array] = {}
+        nbytes = 0
+        # one traced+compiled schedule per DISTINCT (components, dtype)
+        # signature — fused multi-quantity domains share it (a fresh
+        # build_redistribute_fn per quantity would re-trace identical
+        # programs: jit caches by function identity)
+        from stencil_tpu.parallel.redistribute import build_redistribute_fn
+
+        fn_cache: Dict[tuple, object] = {}
+        for h in self._handles:
+            fdt = self.field_dtype(h)
+            sig = (tuple(h.components), jnp.dtype(fdt).name)
+            if sig not in fn_cache:
+                fn_cache[sig] = build_redistribute_fn(
+                    plan, tuple(h.components), fdt
+                )[0]
+            new_curr[h.name] = redistribute_array(
+                plan, self._curr[h.name], h.components, fdt, mesh, _qspec(h),
+                fn=fn_cache[sig],
+            )
+            nbytes += (
+                int(np.prod(tuple(self._size)))
+                * h.cell_count()
+                * jnp.dtype(fdt).itemsize
+            )
+        from_mesh = self.mesh_dim()
+        # install the new geometry + redistributed buffers; fresh zero
+        # ``next`` slot, exactly like realize()
+        self._devices = devices
+        self._force_dim = new_force
+        self.mesh, self.placement = mesh, placement
+        self._spec, self._valid_last, self._shell_radius = spec, vlast, shell
+        self._curr = new_curr
+        gshape = (dim.x * raw.x, dim.y * raw.y, dim.z * raw.z)
+        self._next = {}
+        for h in self._handles:
+            hsharding = NamedSharding(self.mesh, _qspec(h))
+            self._next[h.name] = jnp.zeros(
+                h.components + gshape, dtype=self.field_dtype(h), device=hsharding
+            )
+        # re-realize the exchange plan/executable for the new geometry:
+        # the route re-resolves (explicit pin > env > tuned — the tuner is
+        # re-keyed automatically, tune_key reads the new placement) and the
+        # analytic byte models recompute lazily
+        self._exchange_many_fn = None
+        self._exchange_nbytes = None
+        self._packed_nbytes = self._packed_nkernels = 0
+        self._shell_stale = False
+        t1 = time.perf_counter()
+        self._exchange_route = self._resolve_exchange_route()
+        self._exchange_fn = self._build_exchange_with_ladder()
+        if self._handles:
+            self._record_exchange_compile(t1, f"reshard:{self._exchange_route}")
+        dt = time.perf_counter() - t0
+        telemetry.inc(tm.RESHARDS)
+        telemetry.inc(tm.RESHARD_BYTES, nbytes)
+        telemetry.observe(tm.RESHARD_SECONDS, dt)
+        telemetry.emit_event(
+            tm.EVENT_RESHARD,
+            from_mesh=list(from_mesh),
+            to_mesh=list(self.mesh_dim()),
+            seconds=round(dt, 6),
+            bytes=nbytes,
+            quantities=len(self._handles),
+            source=source,
+        )
+        log_info(
+            f"resharded {self._size} from mesh {from_mesh} to "
+            f"{self.mesh_dim()} in {dt:.3f}s ({nbytes} B moved in-memory)"
+        )
+        return {
+            "seconds": dt,
+            "bytes": nbytes,
+            "from_mesh": list(from_mesh),
+            "to_mesh": list(self.mesh_dim()),
+        }
+
+    def re_realize(self, devices=None, force_dim=None) -> None:
+        """Fresh realize onto a new device set, DISCARDING the in-memory
+        state (fields re-zero, like a first realize): the first half of
+        the checkpoint-elastic-restore fallback — when ``reshard()`` is
+        structurally impossible, the supervisor re-realizes here and
+        restores the last ring checkpoint onto the new mesh."""
+        assert self._realized, "re_realize() follows a realized domain"
+        self._devices = list(devices) if devices is not None else None
+        self._force_dim = Dim3.of(force_dim) if force_dim is not None else None
+        self._curr = {}
+        self._next = {}
+        self._exchange_fn = None
+        self._exchange_many_fn = None
+        self._exchange_nbytes = None
+        self._packed_nbytes = self._packed_nkernels = 0
+        self._shell_stale = False
+        self._realized = False
+        self.realize()
 
     def _resolve_exchange_route(self) -> str:
         """Resolve the z-sweep exchange route for this realize.  Precedence

@@ -162,10 +162,20 @@ class AstarothSim:
                     "overlap=False has no meaning for the fused pallas step; "
                     "use kernel_impl='jnp' for overlap comparisons"
                 )
+        elif self.schedule == "wavefront":
+            raise ValueError("schedule='wavefront' requires kernel_impl='pallas'")
+        self._step = self._build_step()
+
+    def _build_step(self):
+        """The ONE step-construction site, shared by ``realize()`` and
+        ``rebuild_after_reshard`` — every knob threaded into ``make_step``
+        lives here exactly once, so a post-reshard rebuild can never
+        silently drop an axis the first build carried."""
+        if self.kernel_impl == "pallas":
             path = {"auto": "auto", "wavefront": "wavefront", "per-step": "plane"}[
                 self.schedule
             ]
-            self._step = self.dd.make_step(
+            return self.dd.make_step(
                 self._kernel,
                 engine="stream",
                 x_radius=1,
@@ -182,10 +192,14 @@ class AstarothSim:
                 # compute_unit=mxu engage on this kernel
                 mxu_kernel=self._kernel_mxu,
             )
-        else:
-            if self.schedule == "wavefront":
-                raise ValueError("schedule='wavefront' requires kernel_impl='pallas'")
-            self._step = self.dd.make_step(self._kernel, overlap=self.overlap)
+        return self.dd.make_step(self._kernel, overlap=self.overlap)
+
+    def rebuild_after_reshard(self) -> None:
+        """Rebuild the step for the domain's CURRENT mesh — the
+        supervisor's ``on_mesh_change`` hook (the Jacobi3D twin): a
+        reshard or cross-mesh restore leaves ``self.dd`` on the new
+        geometry, and the built step closes over the old one."""
+        self._step = self._build_step()
 
     @property
     def _wavefront_m(self) -> int:

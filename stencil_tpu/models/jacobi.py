@@ -938,6 +938,21 @@ class Jacobi3D:
         val = jnp.where(dist2(cold_c) < in_r2, COLD_TEMP, val)
         return {"temp": val.astype(src.center().dtype)}
 
+    def rebuild_after_reshard(self) -> None:
+        """Rebuild the step function + ladder for the domain's CURRENT
+        mesh — the supervisor's ``on_mesh_change`` hook: a reshard (or a
+        restore onto a different mesh) leaves ``self.dd`` on the new
+        geometry, but the built steps close over the old one.  Device
+        state is untouched; this only re-traces the step builders."""
+        if self.kernel_impl == "pallas":
+            if self._wavefront_m:
+                self._step = self._make_wavefront_step()
+            else:
+                self._step = self._make_pallas_step()
+        else:
+            self._step = self.dd.make_step(self._kernel, overlap=self.overlap)
+        self._ladder = self._make_ladder()
+
     def step(self, steps: int = 1) -> None:
         """Advance ``steps`` RAW iterations — uniform across engines.  The
         XLA route under a halo multiplier is built in macro steps

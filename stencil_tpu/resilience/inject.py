@@ -5,7 +5,7 @@
     entry := phase ':' class [':' label-glob] ['@' skip] ['*' count]
     phase := compile | execute | dispatch | any
     class := vmem_oom | compile_reject | transient | divergence | fatal
-           | sigkill | sigterm
+           | capacity_loss | sigkill | sigterm | shrink | grow
 
 Each entry first lets ``skip`` matching hook calls pass untouched (default
 0 — the chaos harness's "die at the K-th dispatch" primitive), then fires
@@ -43,6 +43,16 @@ name: ``jacobi``, ``astaroth``).  Examples:
            supervisor's handler turns into a final checkpoint + resumable
            exit
 
+    STENCIL_FAULT_PLAN='dispatch:shrink:jacobi@5'
+        -> the 6th jacobi dispatch delivers a seeded CAPACITY-CHANGE
+           notice: the registered capacity handler (the run supervisor
+           installs one) records a pending shrink, drains at the next
+           chunk boundary, and reshards onto half the current mesh's
+           devices ('grow' targets the full fleet).  'capacity_loss'
+           instead RAISES a device-unavailable-worded error — the
+           taxonomy's CAPACITY_LOSS class, exercising the supervisor's
+           reshard-or-restore routing rather than the polite drain
+
 Injected VMEM_OOM / COMPILE_REJECT / TRANSIENT faults are raised as
 ``InjectedFault`` with the SAME message wording the real toolchain emits, so
 they flow through ``classify()``'s substring matching exactly like the real
@@ -79,12 +89,21 @@ _CLASSES = {
     "compile_reject": FailureClass.COMPILE_REJECT,
     "transient": FailureClass.TRANSIENT_RUNTIME,
     "divergence": FailureClass.DIVERGENCE,
+    "capacity_loss": FailureClass.CAPACITY_LOSS,
     "fatal": FailureClass.FATAL,
 }
 #: process-level kill classes: a REAL signal to this process, not an
 #: exception — sigkill models preemption-without-warning (no cleanup runs),
 #: sigterm the polite notice the supervisor checkpoints on
 _KILLS = ("sigkill", "sigterm")
+#: seeded capacity-change notices: no exception, no signal — the hook
+#: calls the REGISTERED capacity handler (``set_capacity_handler``; the
+#: run supervisor installs one for the duration of ``run()``), which
+#: records a pending grow/shrink the supervisor drains and reshards on at
+#: the next chunk boundary.  With no handler installed the notice is
+#: logged and dropped — a fault plan must never crash an unsupervised run
+#: with a primitive only the supervisor can answer.
+_CAPACITY = ("shrink", "grow")
 
 #: The message each injected class carries — the REAL toolchain wording (the
 #: same texts ``taxonomy`` pins), tagged with the injection site.
@@ -99,6 +118,9 @@ _MESSAGES = {
     FailureClass.TRANSIENT_RUNTIME: (
         "UNAVAILABLE: connection reset by peer (remote compile tunnel)"
     ),
+    FailureClass.CAPACITY_LOSS: (
+        "UNAVAILABLE: TPU is unhealthy: lost device at coordinates [0,1,0]"
+    ),
     FailureClass.FATAL: "injected fatal failure",
 }
 
@@ -108,6 +130,7 @@ class _Entry:
     phase: str
     cls: Optional[FailureClass]  # None for the process-kill classes
     kill: Optional[str]  # "sigkill" | "sigterm" | None
+    capacity: Optional[str]  # "shrink" | "grow" | None
     label_glob: str
     skip: int
     remaining: int
@@ -147,15 +170,17 @@ def _parse_entry(text: str) -> _Entry:
         raise ValueError(
             f"{ENV_VAR}: unknown phase {phase!r} (one of {', '.join(_PHASES)})"
         )
-    if cls_name not in _CLASSES and cls_name not in _KILLS:
+    if cls_name not in _CLASSES and cls_name not in _KILLS and cls_name not in _CAPACITY:
         raise ValueError(
             f"{ENV_VAR}: unknown failure class {cls_name!r} "
-            f"(one of {', '.join(_CLASSES)}, {', '.join(_KILLS)})"
+            f"(one of {', '.join(_CLASSES)}, {', '.join(_KILLS)}, "
+            f"{', '.join(_CAPACITY)})"
         )
     return _Entry(
         phase,
         _CLASSES.get(cls_name),
         cls_name if cls_name in _KILLS else None,
+        cls_name if cls_name in _CAPACITY else None,
         label_glob.strip() or "*",
         skip,
         count,
@@ -203,6 +228,9 @@ class FaultPlan:
             if e.kill is not None:
                 _kill(e.kill, phase, label)
                 return  # sigterm: the handler ran; the dispatch proceeds
+            if e.capacity is not None:
+                _capacity_notice(e.capacity, phase, label)
+                return  # a notice, not a failure; the dispatch proceeds
             _raise(e.cls, phase, label)
 
 
@@ -222,6 +250,42 @@ def _kill(kind: str, phase: str, label: str) -> None:
         tm.EVENT_FAULT, phase=phase, label=label, failure_class=kind
     )
     os.kill(os.getpid(), _signal.SIGKILL if kind == "sigkill" else _signal.SIGTERM)
+
+
+#: the registered capacity-change handler (``fn(kind, phase, label)`` with
+#: kind in ``shrink``/``grow``), installed by the run supervisor for the
+#: duration of ``run()`` — jax-free module state, like the plan itself
+_capacity_handler = {"fn": None}
+
+
+def set_capacity_handler(fn) -> object:
+    """Install (or clear, with ``None``) the capacity-notice handler;
+    returns the previous handler so supervisors can nest/restore."""
+    prev = _capacity_handler["fn"]
+    _capacity_handler["fn"] = fn
+    return prev
+
+
+def _capacity_notice(kind: str, phase: str, label: str) -> None:
+    """Deliver a seeded grow/shrink notice to the registered handler (the
+    supervisor's drain-and-reshard entry).  No handler = log and drop —
+    this primitive only means something to a supervised run."""
+    from stencil_tpu import telemetry
+    from stencil_tpu.telemetry import names as tm
+    from stencil_tpu.utils.logging import log_warn
+
+    telemetry.inc(tm.FAULTS_INJECTED)
+    telemetry.emit_event(
+        tm.EVENT_FAULT, phase=phase, label=label, failure_class=kind
+    )
+    fn = _capacity_handler["fn"]
+    if fn is None:
+        log_warn(
+            f"capacity notice {kind!r} injected at {phase}:{label} but no "
+            "handler is registered (no supervisor running); dropped"
+        )
+        return
+    fn(kind, phase, label)
 
 
 def _raise(cls: FailureClass, phase: str, label: str) -> None:

@@ -25,7 +25,25 @@ preemption notice, a SIGKILL, a FATAL dispatch error, a wedged device.
   ``max_restarts`` times (``supervisor.restart`` event + counter per
   restart).  The ladder keeps handling VMEM_OOM/COMPILE_REJECT and retry
   keeps handling TRANSIENT before anything reaches here; DIVERGENCE is
-  never restarted (the same numerics diverge again).
+  never restarted (the same numerics diverge again).  With
+  ``STENCIL_RESTART_WINDOW=N`` set, every N consecutive chunks without a
+  classified failure RESTORE one spent credit — a week-long run cannot
+  exhaust a lifetime budget on early transients (``supervisor.replenish``
+  event per restored credit; the reported restart COUNT keeps growing).
+* **Elastic capacity** — a capacity-change notice (the ``shrink``/``grow``
+  fault hooks, an operator ``SIGUSR1``) is recorded by a registered
+  handler and answered at the next chunk boundary: the in-flight dispatch
+  is DRAINED (watchdog-armed, like every other dispatch) and the domain
+  reshards in memory onto the target mesh
+  (``DistributedDomain.reshard`` — parallel/redistribute.py), continuing
+  in-process with zero disk traffic.  A classified ``CAPACITY_LOSS``
+  dispatch failure routes the same way when the surviving state is
+  trustworthy (single-dispatch chunk, donated buffers intact); whenever
+  redistribution is structurally impossible — devices already gone,
+  consumed buffers, no admissible partition — the recorded fallback is
+  checkpoint-elastic-restore onto the surviving mesh, charged against the
+  restart budget (a clean reshard never is).  ``on_mesh_change`` lets the
+  caller rebuild step functions closed over the old mesh.
 * **Flight recorder** — a rank-0 ``status.json`` heartbeat in the
   checkpoint dir per chunk (step, steady-state rate, checkpoint age,
   watchdog state, restart count, last classified error) and a
@@ -39,7 +57,8 @@ Knobs (validated reads — utils/config.py): ``STENCIL_CHECKPOINT_DIR``,
 (wall-clock), ``STENCIL_CHECKPOINT_KEEP`` (ring size),
 ``STENCIL_CHECKPOINT_BACKEND`` (auto|npz|orbax),
 ``STENCIL_CHECKPOINT_VERIFY`` (digest checks on restore),
-``STENCIL_SUPERVISOR_RESTARTS`` (restart budget).
+``STENCIL_SUPERVISOR_RESTARTS`` (restart budget),
+``STENCIL_RESTART_WINDOW`` (healthy chunks per replenished credit; 0=off).
 """
 
 from __future__ import annotations
@@ -77,6 +96,9 @@ class SupervisorConfig:
     max_restarts: int = 2
     backend: Optional[str] = None  # None = orbax when installed, else npz
     verify: bool = True
+    # healthy chunks per replenished restart credit (0 = never replenish):
+    # the budget bounds failure DENSITY, not lifetime failures
+    restart_window: int = 0
 
     @classmethod
     def from_env(cls, dir: Optional[str] = None, **overrides) -> Optional["SupervisorConfig"]:
@@ -104,6 +126,7 @@ class SupervisorConfig:
             max_restarts=env_int("STENCIL_SUPERVISOR_RESTARTS", 2, minimum=0),
             backend=None if backend == "auto" else backend,
             verify=env_bool("STENCIL_CHECKPOINT_VERIFY", True),
+            restart_window=env_int("STENCIL_RESTART_WINDOW", 0, minimum=0),
         )
         fields.update(overrides)
         return cls(**fields)
@@ -138,6 +161,7 @@ class RunSupervisor:
         label: str = "run",
         run_state: Optional[Callable[[], dict]] = None,
         flight: Optional[FlightRecorder] = None,
+        on_mesh_change: Optional[Callable[[], None]] = None,
     ):
         self.dd = dd
         self.config = config
@@ -153,9 +177,27 @@ class RunSupervisor:
         self.flight = flight if flight is not None else FlightRecorder(
             config.dir, label=label
         )
+        #: rebuild hook for steps closed over the old mesh: called after
+        #: every completed reshard and after a restore that changed the
+        #: mesh (docs/resilience.md "Elastic capacity")
+        self.on_mesh_change = on_mesh_change
         self._last_error: Optional[str] = None
         self._preempted = False
         self._preempt_why = ""
+        #: pending capacity-change notice ("shrink"/"grow"/"refit"),
+        #: answered at the next chunk boundary
+        self._capacity_request: Optional[str] = None
+        #: completed mesh transitions (reshard + restore fallbacks) this
+        #: process: heartbeat history + the soak's per-transition timings
+        self.mesh_history: list = []
+        self._restarts = 0  # total restarts+fallbacks (reporting)
+        self._credits_used = 0  # budget charge (replenishable)
+        self._healthy_chunks = 0
+        #: consecutive capacity-loss recoveries with no successful chunk
+        #: between them — a repeat means continuing in place did NOT fix
+        #: it, so the next recovery must go through the budget-bounded
+        #: fallback instead of spinning on a dead chip forever
+        self._capacity_streak = 0
 
     # --- resume ---------------------------------------------------------------
 
@@ -214,14 +256,23 @@ class RunSupervisor:
             f"armed({wd.deadline_s:g}s{', abort' if wd.abort else ''})"
         )
 
+    def _mesh_dim(self) -> Optional[list]:
+        dim = getattr(self.dd, "mesh_dim", None)
+        try:
+            return list(dim()) if dim is not None else None
+        except Exception:  # noqa: BLE001 — a heartbeat must never raise
+            return None
+
     def _heartbeat(
         self, step: int, total_steps: int, restarts: int, last_ck: float,
         phase: str = "running",
     ) -> None:
         """One status.json rewrite: progress, rate, checkpoint age,
-        watchdog arming, restart count, last classified error, and the
-        caller's run_state (which carries the decisions in effect —
-        ladder rung / kernel axes when the model exposes them)."""
+        watchdog arming, restart count, the current MESH plus the
+        transition count/history (the elastic-capacity breadcrumbs), last
+        classified error, and the caller's run_state (which carries the
+        decisions in effect — ladder rung / kernel axes when the model
+        exposes them)."""
         self.flight.heartbeat(
             step,
             total_steps,
@@ -229,8 +280,212 @@ class RunSupervisor:
             checkpoint_age_s=round(time.monotonic() - last_ck, 3),
             restarts=restarts,
             watchdog=self._watchdog_state(),
+            mesh=self._mesh_dim(),
+            mesh_transitions=len(self.mesh_history),
+            mesh_history=self.mesh_history[-8:],
             last_error=self._last_error,
             run_state=self._run_state() if self._run_state is not None else None,
+        )
+
+    # --- elastic capacity -----------------------------------------------------
+
+    def _on_capacity_notice(self, kind: str, phase: str, label: str) -> None:
+        """The registered fault-hook/operator entry: record the pending
+        change; the run loop drains and reshards at the chunk boundary."""
+        self._capacity_request = kind
+        log_warn(
+            f"{self.label}: capacity-change notice {kind!r} "
+            f"({phase}:{label}); will drain and reshard at the next step "
+            "boundary"
+        )
+
+    def _capacity_target(self, kind: str) -> Optional[list]:
+        """Target devices for a capacity change, or None for a no-op.
+        ``grow``/``refit`` re-fit to the full visible fleet; ``shrink``
+        halves the current mesh's devices (the seeded soak primitive —
+        a real deployment hands explicit device sets through
+        ``DistributedDomain.reshard`` directly)."""
+        import jax
+
+        current = list(self.dd.mesh.devices.flat)
+        if kind == "shrink":
+            target = current[: max(len(current) // 2, 1)]
+        else:  # grow / refit
+            target = list(jax.devices())
+        # compare as SETS: the placement orders the device grid itself, so
+        # the same fleet in a different grid order is still a no-op refit
+        if {d.id for d in target} == {d.id for d in current}:
+            return None
+        return target
+
+    def _drain(self) -> None:
+        """Wait out the in-flight dispatch before touching the mesh —
+        watchdog-armed like every other dispatch, so a wedged drain still
+        trips the stall machinery instead of hanging the reshard."""
+        watched = getattr(self.dd, "_watched_call", None)
+        if watched is not None:
+            watched("reshard:drain", lambda: list(self.dd._curr.values()))
+        else:
+            self.dd.block_until_ready()
+
+    def _record_transition(self, kind: str, step: int, from_mesh, to_mesh,
+                           seconds: float, source: str) -> None:
+        self.mesh_history.append(
+            {
+                "kind": kind,
+                "step": int(step),
+                "from": list(from_mesh) if from_mesh is not None else None,
+                "to": list(to_mesh) if to_mesh is not None else None,
+                "seconds": round(float(seconds), 6),
+                "source": source,
+            }
+        )
+
+    def _charge_fallback(self, step: int, target, why: str) -> Optional[int]:
+        """The checkpoint-elastic-restore fallback: re-realize on the
+        target mesh (fresh buffers) when it differs, restore the newest
+        ring entry, and charge ONE restart credit.  Returns the restored
+        step, or None when the budget is exhausted / nothing restores —
+        the caller then propagates the original failure."""
+        cfg = self.config
+        if self._credits_used >= cfg.max_restarts:
+            log_warn(
+                f"{self.label}: capacity fallback needed ({why}) but the "
+                f"restart budget is exhausted "
+                f"({self._credits_used}/{cfg.max_restarts})"
+            )
+            return None
+        from_mesh = self._mesh_dim()
+        t0 = time.monotonic()
+        # ALWAYS re-realize, even when the target equals the current mesh:
+        # the failed reshard may have died AFTER installing the new
+        # geometry (a terminal exchange-compile rejection), leaving a
+        # half-resharded domain whose mesh already matches the target — a
+        # conditional re_realize would skip the rebuild and restore onto
+        # wreckage.  A fresh realize on the same device set is cheap next
+        # to the restore itself.
+        current = list(self.dd.mesh.devices.flat)
+        self.dd.re_realize(devices=target if target is not None else current)
+        restored = self.resume()
+        if self.resumed_path is None:
+            log_warn(
+                f"{self.label}: capacity fallback found no valid checkpoint "
+                f"under {cfg.dir}"
+            )
+            return None
+        self._credits_used += 1
+        self._restarts += 1
+        self._healthy_chunks = 0
+        telemetry.inc(tm.RESHARD_FALLBACKS)
+        telemetry.inc(tm.SUPERVISOR_RESTARTS)
+        telemetry.emit_event(
+            tm.EVENT_RESHARD_FALLBACK,
+            from_mesh=from_mesh,
+            to_mesh=self._mesh_dim(),
+            why=why[:300],
+            step=restored,
+        )
+        self._record_transition(
+            "restore", restored, from_mesh, self._mesh_dim(),
+            time.monotonic() - t0, "fallback",
+        )
+        # unconditional: the re_realize above re-traced the domain even on
+        # an unchanged mesh, so steps closed over the old objects must
+        # always be rebuilt
+        if self.on_mesh_change is not None:
+            self.on_mesh_change()
+        log_warn(
+            f"{self.label}: capacity change fell back to "
+            f"checkpoint-elastic-restore at step {restored} ({why}); "
+            f"budget {self._credits_used}/{cfg.max_restarts}"
+        )
+        return restored
+
+    def _apply_capacity_request(self, step: int) -> int:
+        """Answer a pending grow/shrink/refit notice at the chunk
+        boundary: drain, reshard in memory (clean — no budget charge),
+        fall back to checkpoint-elastic-restore when redistribution is
+        structurally impossible.  Raises the reshard error when even the
+        fallback cannot proceed."""
+        kind = self._capacity_request
+        self._capacity_request = None
+        target = self._capacity_target(kind)
+        if target is None:
+            log_info(
+                f"{self.label}: capacity notice {kind!r} is a no-op "
+                "(target mesh equals the current one)"
+            )
+            return step
+        self._drain()
+        from_mesh = self._mesh_dim()
+        try:
+            stats = self.dd.reshard(devices=target, source="request")
+        except Exception as e:  # noqa: BLE001 — every reshard failure has
+            # the same answer: the recorded restore fallback
+            restored = self._charge_fallback(step, target, why=str(e))
+            if restored is None:
+                self.flight.crash_report("capacity_loss", error=str(e))
+                raise
+            return restored
+        self._record_transition(
+            "reshard", step, from_mesh, self._mesh_dim(),
+            stats["seconds"], kind,
+        )
+        if self.on_mesh_change is not None:
+            self.on_mesh_change()
+        return step
+
+    def _recover_capacity_loss(self, step: int, n: int, exc) -> Optional[int]:
+        """A classified CAPACITY_LOSS dispatch failure: reshard in memory
+        when the surviving state is trustworthy — the chunk was a single
+        dispatch (a failed dispatch assigns nothing, so the domain is
+        exactly at ``step``) and no donated buffer was consumed — else the
+        checkpoint fallback.  Returns the step to continue from, or None
+        to propagate."""
+        from stencil_tpu.resilience.retry import buffers_live
+
+        kind = self._capacity_request or "refit"
+        self._capacity_request = None
+        target = self._capacity_target(kind)
+        # a REPEATED capacity loss with no successful chunk in between
+        # means the previous recovery did not fix anything (on real
+        # hardware jax.devices() is a static list — a dead chip never
+        # leaves it, so the refit target can look like a no-op forever):
+        # route repeats through the budget-bounded fallback instead of
+        # spinning on the dead chip with zero budget charged
+        repeat = self._capacity_streak > 0
+        self._capacity_streak += 1
+        trusted = n == 1 and buffers_live(self.dd._curr)
+        if trusted and not repeat:
+            if target is None:
+                # fleet unchanged and state intact: the loss was transient
+                # at the fleet level (or injected); continue in place ONCE
+                log_warn(
+                    f"{self.label}: capacity loss at step {step} but the "
+                    "fleet is unchanged and the state intact; continuing"
+                )
+                return step
+            from_mesh = self._mesh_dim()
+            try:
+                stats = self.dd.reshard(devices=target, source="capacity_loss")
+            except Exception as e:  # noqa: BLE001 — fall back below
+                log_warn(
+                    f"{self.label}: in-memory reshard after capacity loss "
+                    f"failed ({e}); falling back to checkpoint restore"
+                )
+            else:
+                self._record_transition(
+                    "reshard", step, from_mesh, self._mesh_dim(),
+                    stats["seconds"], "capacity_loss",
+                )
+                if self.on_mesh_change is not None:
+                    self.on_mesh_change()
+                return step
+        return self._charge_fallback(
+            step, target,
+            why=f"capacity loss mid-chunk: {str(exc)[:200]}"
+            if not trusted
+            else f"capacity loss: {str(exc)[:200]}",
         )
 
     # --- preemption -----------------------------------------------------------
@@ -256,6 +511,22 @@ class RunSupervisor:
         try:
             return signal.signal(signal.SIGTERM, handler)
         except (ValueError, OSError):  # non-main interpreter contexts
+            return _NOT_INSTALLED
+
+    def _install_sigusr1(self):
+        """SIGUSR1 -> the operator's capacity signal: re-fit the mesh to
+        the currently visible fleet at the next chunk boundary (drain +
+        reshard, checkpoint-restore fallback).  Main thread only, like
+        SIGTERM."""
+        if threading.current_thread() is not threading.main_thread():
+            return _NOT_INSTALLED
+
+        def handler(signum, frame):
+            self._on_capacity_notice("refit", "signal", "SIGUSR1")
+
+        try:
+            return signal.signal(signal.SIGUSR1, handler)
+        except (ValueError, OSError, AttributeError):  # non-main / no USR1
             return _NOT_INSTALLED
 
     # --- the supervised loop --------------------------------------------------
@@ -286,9 +557,17 @@ class RunSupervisor:
             else:
                 chunk = max(total_steps - step, 1)
         chunk = max(int(chunk), 1)
-        restarts = 0
+        self._restarts = 0
+        self._credits_used = 0
+        self._healthy_chunks = 0
+        self._capacity_streak = 0
+        self._capacity_request = None
         self._preempted = False
         prev_handler = self._install_sigterm()
+        prev_usr1 = self._install_sigusr1()
+        from stencil_tpu.resilience import inject
+
+        prev_capacity = inject.set_capacity_handler(self._on_capacity_notice)
         last_ck = time.monotonic()
         from stencil_tpu.io.checkpoint import ring_entries
 
@@ -300,7 +579,7 @@ class RunSupervisor:
             self.checkpoint(step, reason="initial")
         # first heartbeat before any chunk: a kill during the very first
         # dispatch must still leave a readable status.json
-        self._heartbeat(step, total_steps, restarts, last_ck)
+        self._heartbeat(step, total_steps, self._restarts, last_ck)
         try:
             while step < total_steps:
                 n = min(chunk, total_steps - step)
@@ -315,6 +594,7 @@ class RunSupervisor:
                 except (Exception, KeyboardInterrupt) as e:
                     cls = classify(e)
                     self._last_error = f"{cls.value}: {str(e)[:300]}"
+                    self._healthy_chunks = 0
                     if cls is FailureClass.PREEMPTED:
                         # the chunk died partway: the domain is an UNKNOWN
                         # number of iterations past `step`, so no final
@@ -324,9 +604,21 @@ class RunSupervisor:
                         self._preempted = True
                         mid_chunk = True
                         self._preempt_why = self._preempt_why or type(e).__name__
+                    elif cls is FailureClass.CAPACITY_LOSS:
+                        # the FLEET changed under the run: reshard in
+                        # memory when the surviving state is trustworthy,
+                        # else the budget-charged checkpoint fallback
+                        recovered = self._recover_capacity_loss(step, n, e)
+                        if recovered is None:
+                            self.flight.crash_report(cls.value, error=str(e))
+                            raise
+                        step = recovered
+                        last_ck = time.monotonic()
+                        self._heartbeat(step, total_steps, self._restarts, last_ck)
+                        continue
                     elif (
                         cls in (FailureClass.FATAL, FailureClass.STALL)
-                        and restarts < cfg.max_restarts
+                        and self._credits_used < cfg.max_restarts
                     ):
                         restored = self.resume()
                         if self.resumed_path is None:
@@ -334,13 +626,14 @@ class RunSupervisor:
                             # final, so dump the post-mortem first
                             self.flight.crash_report(cls.value, error=str(e))
                             raise
-                        restarts += 1
+                        self._restarts += 1
+                        self._credits_used += 1
                         telemetry.inc(tm.SUPERVISOR_RESTARTS)
                         telemetry.emit_event(
                             tm.EVENT_SUPERVISOR_RESTART,
                             label=self.label,
                             step=step,
-                            restart=restarts,
+                            restart=self._restarts,
                             budget=cfg.max_restarts,
                             failure_class=cls.value,
                             error=str(e)[:300],
@@ -348,11 +641,11 @@ class RunSupervisor:
                         log_warn(
                             f"{self.label}: {cls.value} at step ~{step} "
                             f"({e}); restarting from the last checkpoint "
-                            f"({restarts}/{cfg.max_restarts})"
+                            f"({self._credits_used}/{cfg.max_restarts})"
                         )
                         step = restored
                         last_ck = time.monotonic()
-                        self._heartbeat(step, total_steps, restarts, last_ck)
+                        self._heartbeat(step, total_steps, self._restarts, last_ck)
                         continue
                     else:
                         # out of budget, no checkpoint to restart from, or a
@@ -364,7 +657,32 @@ class RunSupervisor:
                     step += n
                     if on_chunk is not None:
                         on_chunk(step, n)
-                    self._heartbeat(step, total_steps, restarts, last_ck)
+                    self._capacity_streak = 0
+                    # sustained healthy progress replenishes one restart
+                    # credit (STENCIL_RESTART_WINDOW): the budget bounds
+                    # failure DENSITY, not lifetime failures — the
+                    # reported restart COUNT keeps growing
+                    self._healthy_chunks += 1
+                    if (
+                        cfg.restart_window
+                        and self._credits_used > 0
+                        and self._healthy_chunks >= cfg.restart_window
+                    ):
+                        self._credits_used -= 1
+                        self._healthy_chunks = 0
+                        telemetry.emit_event(
+                            tm.EVENT_SUPERVISOR_REPLENISH,
+                            label=self.label,
+                            step=step,
+                            window=cfg.restart_window,
+                            credits_used=self._credits_used,
+                        )
+                        log_info(
+                            f"{self.label}: {cfg.restart_window} healthy "
+                            f"chunks — one restart credit replenished "
+                            f"({self._credits_used}/{cfg.max_restarts} used)"
+                        )
+                    self._heartbeat(step, total_steps, self._restarts, last_ck)
                 if self._preempted:
                     if mid_chunk:
                         log_warn(
@@ -379,7 +697,8 @@ class RunSupervisor:
                         f"step {step}; exiting resumable (code {EXIT_RESUMABLE})"
                     )
                     self._heartbeat(
-                        step, total_steps, restarts, last_ck, phase="preempted"
+                        step, total_steps, self._restarts, last_ck,
+                        phase="preempted",
                     )
                     self.flight.crash_report(
                         "preempted",
@@ -390,10 +709,16 @@ class RunSupervisor:
                     return RunOutcome(
                         completed=False,
                         step=step,
-                        restarts=restarts,
+                        restarts=self._restarts,
                         preempted=True,
                         exit_code=EXIT_RESUMABLE,
                     )
+                if self._capacity_request is not None and step < total_steps:
+                    # answer the pending grow/shrink/refit notice at the
+                    # boundary: the step counter is exact here, so a clean
+                    # in-memory reshard keeps bitwise continuity
+                    step = self._apply_capacity_request(step)
+                    self._heartbeat(step, total_steps, self._restarts, last_ck)
                 now = time.monotonic()
                 hit_steps = cfg.every_steps and step % cfg.every_steps == 0
                 hit_wall = cfg.every_seconds and now - last_ck >= cfg.every_seconds
@@ -401,6 +726,7 @@ class RunSupervisor:
                     self.checkpoint(step, reason="cadence")
                     last_ck = now
         finally:
+            inject.set_capacity_handler(prev_capacity)
             if prev_handler is not _NOT_INSTALLED:
                 # a C-level previous handler reads back as None — restore
                 # the default disposition rather than leaving OUR handler
@@ -409,11 +735,17 @@ class RunSupervisor:
                     signal.SIGTERM,
                     prev_handler if prev_handler is not None else signal.SIG_DFL,
                 )
+            if prev_usr1 is not _NOT_INSTALLED:
+                signal.signal(
+                    signal.SIGUSR1,
+                    prev_usr1 if prev_usr1 is not None else signal.SIG_DFL,
+                )
         # completion checkpoint: the artifact soak/chaos harnesses compare
         # (manifest digests make that a metadata read), and the natural
         # resume-past-the-end no-op marker
         self.checkpoint(step, reason="final")
         self._heartbeat(
-            step, total_steps, restarts, time.monotonic(), phase="completed"
+            step, total_steps, self._restarts, time.monotonic(),
+            phase="completed",
         )
-        return RunOutcome(completed=True, step=step, restarts=restarts)
+        return RunOutcome(completed=True, step=step, restarts=self._restarts)

@@ -1,6 +1,6 @@
 """Failure taxonomy: one ``classify(exc)`` for every error-handling site.
 
-Seven classes cover everything the framework reacts to differently:
+Eight classes cover everything the framework reacts to differently:
 
 * ``VMEM_OOM``          — Mosaic rejected a kernel because its scoped-VMEM
   request does not fit (the calibrated model under-estimated on this
@@ -28,6 +28,16 @@ Seven classes cover everything the framework reacts to differently:
   Handled like FATAL by in-process machinery (no retry — the same dispatch
   would wedge again); the supervisor's restart-from-checkpoint budget is
   the recovery rung.
+* ``CAPACITY_LOSS``     — the FLEET changed under the run: a device became
+  unhealthy, a slice-health monitor reported missing chips, a worker was
+  removed.  Never blindly retried (the devices are gone — re-running the
+  same dispatch re-fails) and never degraded (no shallower kernel brings a
+  chip back): the supervisor routes it to the elastic-capacity path —
+  drain, then ``DistributedDomain.reshard`` onto the surviving mesh, with
+  checkpoint-elastic-restore as the fallback (docs/resilience.md "Elastic
+  capacity").  The markers are checked BEFORE the transient list because
+  real device-loss wordings carry the gRPC ``UNAVAILABLE:`` prefix that
+  would otherwise classify them retryable.
 * ``FATAL``             — everything else.  Propagates unchanged.
 
 Classification is by exception type first (``ResilienceError`` subclasses
@@ -49,6 +59,7 @@ class FailureClass(enum.Enum):
     DIVERGENCE = "divergence"
     PREEMPTED = "preempted"
     STALL = "stall"
+    CAPACITY_LOSS = "capacity_loss"
     FATAL = "fatal"
 
 
@@ -155,6 +166,22 @@ _TRANSIENT_MARKERS = (
     "response body closed",
 )
 
+#: Device-unavailable / slice-health wordings: the fleet changed under the
+#: run.  Checked BEFORE the transient list — the PJRT/megascale device-loss
+#: texts carry the gRPC "UNAVAILABLE:" prefix, and a blind retry against a
+#: missing chip re-fails forever; the supervisor's reshard/restore path is
+#: the only recovery.  Current toolchain wordings (pinned by tests):
+#:   "TPU is unhealthy: lost device at coordinates ..."   (PJRT health)
+#:   "The TPU slice health check failed: worker N ..."    (megascale)
+#:   "Device coordinator reported missing chips ..."      (coordinator)
+#:   "device has been removed"                            (hot-unplug)
+_CAPACITY_MARKERS = (
+    "is unhealthy",
+    "slice health",
+    "missing chips",
+    "device has been removed",
+)
+
 #: Non-VMEM Mosaic/XLA capability rejections observed by this repo's probes
 #: (each wording is pinned by tests):
 #:   "Target does not support this comparison"    (16-bit vector compare)
@@ -203,6 +230,11 @@ def classify(exc: BaseException) -> FailureClass:
     msg = str(exc).lower()
     if "vmem" in msg and any(m in msg for m in _VMEM_OOM_MARKERS):
         return FailureClass.VMEM_OOM
+    # capacity loss BEFORE transient: device-loss wordings brush the
+    # "unavailable:" gRPC prefix, and re-running against a missing chip is
+    # not a retry, it is a hang with extra steps (pinned by tests)
+    if any(m in msg for m in _CAPACITY_MARKERS):
+        return FailureClass.CAPACITY_LOSS
     if any(m in msg for m in _TRANSIENT_MARKERS):
         return FailureClass.TRANSIENT_RUNTIME
     if any(m in msg for m in _COMPILE_REJECT_MARKERS):

@@ -66,14 +66,28 @@ def render(status, crash, stale_after: float = 300.0) -> str:
             ("restarts", "restarts"),
             ("ladder_rung", "ladder rung"),
             ("watchdog", "watchdog"),
+            ("mesh", "mesh"),
+            ("mesh_transitions", "mesh transitions"),
         ):
             if status.get(key) is not None:
                 val = status[key]
                 if key == "checkpoint_age_s":
                     val = f"{float(val):.1f}s"
+                if key == "mesh" and isinstance(val, list):
+                    val = "x".join(str(v) for v in val)
                 extras.append(f"{label} {val}")
         if extras:
             lines.append("  " + ", ".join(extras))
+        # elastic-capacity breadcrumbs: the last few grow/shrink moves
+        # (in-memory reshards and restore fallbacks), live or post-mortem
+        history = status.get("mesh_history") or []
+        for t in history[-5:]:
+            frm = "x".join(str(v) for v in (t.get("from") or [])) or "?"
+            to = "x".join(str(v) for v in (t.get("to") or [])) or "?"
+            lines.append(
+                f"  mesh {t.get('kind', '?')} at step {t.get('step')}: "
+                f"{frm} -> {to} in {t.get('seconds')}s ({t.get('source')})"
+            )
         if status.get("last_error"):
             lines.append(f"  last error: {status['last_error']}")
     if crash is not None:

@@ -141,6 +141,30 @@ def entries_from_artifact(path: str) -> List[dict]:
                 )
         return [e for e in out if e is not None]
 
+    if isinstance(doc, dict) and doc.get("bench") == "soak_kill_resume":
+        # the chaos soak (scripts/run_soak.py): recovery wall clock and the
+        # per-transition in-memory reshard timings — both LOWER-is-better
+        # (``better: "lower"``; the gate flags rises, not drops).  Only
+        # bitwise-identical soaks land: a failed soak's timings describe a
+        # broken run, not a perf point.
+        if not doc.get("bitwise_identical"):
+            return []
+        out.append(
+            _entry(
+                ts, "soak:recovery_seconds", doc.get("recovery_seconds"),
+                "s", source, better="lower", kills=len(doc.get("kills") or []),
+            )
+        )
+        rs = [v for v in doc.get("reshard_seconds") or [] if isinstance(v, (int, float))]
+        if rs:
+            out.append(
+                _entry(
+                    ts, "reshard:seconds", _median(rs), "s", source,
+                    better="lower", transitions=len(rs),
+                )
+            )
+        return [e for e in out if e is not None]
+
     if isinstance(doc, dict) and doc.get("bench") == "exchange":
         # bench_exchange's route A/B (the packed-route wins): direct's
         # steady-state rate plus every packed route's speedup-vs-direct —
@@ -250,12 +274,15 @@ def check_regressions(
     window: int = DEFAULT_WINDOW,
 ) -> Tuple[List[dict], List[dict]]:
     """Gate every series: newest value vs the median of up to ``window``
-    trailing entries (series are higher-is-better throughputs).  Returns
+    trailing entries.  Series are higher-is-better throughputs unless the
+    newest entry carries ``better: "lower"`` (the soak's seconds series) —
+    there a RISE past the threshold flags instead of a drop.  Returns
     ``(rows, regressions)`` — one row per series with >= 2 entries:
 
         {"key", "value", "trailing_median", "ratio", "n", "regressed"}
 
-    ``regressed`` is True when ``value < (1 - threshold) * median``.
+    ``regressed`` is True when ``value < (1 - threshold) * median`` (or
+    ``value > (1 + threshold) * median`` for lower-is-better series).
     Single-entry series have no history to regress against and are
     reported with ``trailing_median: None``.
     """
@@ -284,9 +311,14 @@ def check_regressions(
         }
         if prior and row["trailing_median"]:
             row["ratio"] = round(newest["value"] / row["trailing_median"], 4)
-            row["regressed"] = newest["value"] < (1.0 - threshold) * row[
-                "trailing_median"
-            ]
+            if newest.get("better") == "lower":
+                row["regressed"] = newest["value"] > (1.0 + threshold) * row[
+                    "trailing_median"
+                ]
+            else:
+                row["regressed"] = newest["value"] < (1.0 - threshold) * row[
+                    "trailing_median"
+                ]
         rows.append(row)
         if row["regressed"]:
             regressions.append(row)

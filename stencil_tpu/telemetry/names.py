@@ -91,6 +91,17 @@ WATCHDOG_STALLS = "watchdog.stalls"
 #: ``--profile-dir``); 0 when profiling is off or the backend has no
 #: profiler (the capture degrades to a warn, never a crash)
 PROFILE_CAPTURES = "profile.captures"
+#: completed in-memory mesh transitions (``DistributedDomain.reshard`` —
+#: parallel/redistribute.py): live grow/shrink moves that never touched
+#: disk; the checkpoint-elastic-restore fallback counts separately below
+RESHARDS = "reshard.count"
+#: analytic bytes of interior state moved by those resharding collectives
+#: (whole valid interior at the stored dtype, every quantity)
+RESHARD_BYTES = "reshard.bytes"
+#: capacity changes that could NOT reshard in memory and fell back to
+#: checkpoint-elastic-restore (devices gone, no admissible partition,
+#: consumed buffers) — each one also charges the supervisor restart budget
+RESHARD_FALLBACKS = "reshard.fallbacks"
 
 ALL_COUNTERS = frozenset({
     EXCHANGE_COUNT,
@@ -119,6 +130,9 @@ ALL_COUNTERS = frozenset({
     SUPERVISOR_RESTARTS,
     WATCHDOG_STALLS,
     PROFILE_CAPTURES,
+    RESHARDS,
+    RESHARD_BYTES,
+    RESHARD_FALLBACKS,
 })
 
 # --- gauges (last-value) -----------------------------------------------------
@@ -149,6 +163,9 @@ LADDER_BUILD_SECONDS = "resilience.ladder.build_seconds"
 CHECKPOINT_SAVE_SECONDS = "checkpoint.save.seconds"
 #: wall seconds per checkpoint restore (load + verify + re-scatter)
 CHECKPOINT_RESTORE_SECONDS = "checkpoint.restore.seconds"
+#: wall seconds per in-memory mesh transition (plan + collective schedule
+#: + exchange re-realize + tuner re-key — ``DistributedDomain.reshard``)
+RESHARD_SECONDS = "reshard.seconds"
 
 ALL_HISTOGRAMS = frozenset({
     STEP_SECONDS,
@@ -158,6 +175,7 @@ ALL_HISTOGRAMS = frozenset({
     LADDER_BUILD_SECONDS,
     CHECKPOINT_SAVE_SECONDS,
     CHECKPOINT_RESTORE_SECONDS,
+    RESHARD_SECONDS,
 })
 
 # --- spans (Chrome-trace timeline entries) -----------------------------------
@@ -172,6 +190,10 @@ SPAN_SWAP = "domain.swap"
 #: the tier-1/tier-2 overlap proofs key on the interior scope name.
 SPAN_OVERLAP_INTERIOR = "step.overlap.interior"
 SPAN_OVERLAP_EXTERIOR = "step.overlap.exterior"
+#: the redistribution collective schedule (parallel/redistribute.py): a
+#: named scope entered around the per-round slice/permute/blend body, so
+#: device-time attribution can price a live mesh transition
+SPAN_RESHARD = "reshard.collective"
 
 ALL_SPANS = frozenset({
     SPAN_STEP,
@@ -179,6 +201,7 @@ ALL_SPANS = frozenset({
     SPAN_SWAP,
     SPAN_OVERLAP_INTERIOR,
     SPAN_OVERLAP_EXTERIOR,
+    SPAN_RESHARD,
 })
 
 # --- structured events (JSONL sink) ------------------------------------------
@@ -247,6 +270,15 @@ EVENT_WATCHDOG_STALL = "watchdog.stall"
 #: a cadence device-profile capture finished (fields: dir, index,
 #: seconds — telemetry/device.py)
 EVENT_PROFILE_CAPTURE = "profile.capture"
+#: an in-memory mesh transition completed (fields: from_mesh, to_mesh,
+#: seconds, bytes, quantities, source=request|capacity_loss|operator)
+EVENT_RESHARD = "reshard.transition"
+#: a capacity change fell back to checkpoint-elastic-restore (fields:
+#: from_mesh, to_mesh, why, step) — charged against the restart budget
+EVENT_RESHARD_FALLBACK = "reshard.fallback"
+#: sustained healthy progress restored one restart credit (fields: label,
+#: step, window, credits_used — STENCIL_RESTART_WINDOW)
+EVENT_SUPERVISOR_REPLENISH = "supervisor.replenish"
 
 ALL_EVENTS = frozenset({
     EVENT_COMPILE,
@@ -270,6 +302,9 @@ ALL_EVENTS = frozenset({
     EVENT_SUPERVISOR_RESTART,
     EVENT_WATCHDOG_STALL,
     EVENT_PROFILE_CAPTURE,
+    EVENT_RESHARD,
+    EVENT_RESHARD_FALLBACK,
+    EVENT_SUPERVISOR_REPLENISH,
 })
 
 #: every registered name, any kind — what the lint checks literals against

@@ -131,6 +131,47 @@ class TestIngest:
         # and the gate consumes them like any other series
         assert ledger.append_entries(str(tmp_path / "l.jsonl"), entries) == 3
 
+    def test_soak_summary_reshard_series(self, tmp_path):
+        """The chaos soak's summary lands as LOWER-is-better series:
+        recovery wall clock plus the median in-memory reshard time — and
+        the gate flags a RISE there, not a drop.  A failed soak (digests
+        differ) contributes nothing."""
+        doc = {
+            "bench": "soak_kill_resume",
+            "bitwise_identical": True,
+            "kills": [{"kill": 1}, {"kill": 2}],
+            "reshard_seconds": [0.4, 0.2, 0.3],
+            "recovery_seconds": 9.5,
+        }
+        p = tmp_path / "soak_summary.json"
+        p.write_text(json.dumps(doc))
+        entries = ledger.entries_from_artifact(str(p))
+        by_key = {e["key"]: e for e in entries}
+        assert by_key["soak:recovery_seconds"]["value"] == 9.5
+        assert by_key["soak:recovery_seconds"]["better"] == "lower"
+        assert by_key["reshard:seconds"]["value"] == 0.3  # the median
+        assert by_key["reshard:seconds"]["better"] == "lower"
+        # a rise flags, a drop (improvement) does not
+        lpath = str(tmp_path / "l.jsonl")
+        ledger.append_entries(lpath, entries)
+        worse = [dict(e, ts=e["ts"] + 1, source="next.json",
+                      value=e["value"] * 2) for e in entries]
+        ledger.append_entries(lpath, worse)
+        _, regressions = ledger.check_regressions(ledger.read_ledger(lpath))
+        assert {r["key"] for r in regressions} == {
+            "soak:recovery_seconds", "reshard:seconds",
+        }
+        improved = [dict(e, ts=e["ts"] + 2, source="best.json",
+                         value=e["value"] * 0.5) for e in entries]
+        ledger.append_entries(lpath, improved)
+        _, regressions = ledger.check_regressions(ledger.read_ledger(lpath))
+        assert not regressions
+        # failed soaks are not perf points
+        bad = dict(doc, bitwise_identical=False)
+        p2 = tmp_path / "bad_soak.json"
+        p2.write_text(json.dumps(bad))
+        assert ledger.entries_from_artifact(str(p2)) == []
+
     def test_bench_mxu_ab_legs(self, tmp_path):
         """bench.py's mxu_vs_vpu section lands each compute-unit leg as a
         regression-gated mxu_ab:* series (vpu / mxu / mxu_band /

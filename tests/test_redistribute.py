@@ -1,0 +1,282 @@
+"""Tier-1: the on-device redistribution collective + ``DistributedDomain.
+reshard`` (parallel/redistribute.py, docs/resilience.md "Elastic capacity").
+
+The headline pin is the reshard-vs-restore EQUIVALENCE MATRIX: for every
+grow/shrink mesh pair × uneven shards × halo-multiplier shells × dtype
+config, ``reshard(new_mesh)`` must land the raw global arrays BITWISE
+identical to the checkpoint-elastic-restore path (save on mesh A, fresh
+domain on mesh B, restore) — the in-memory move is the disk round trip
+minus the disk.  Plus: plan-level invariants (permutation rounds, full
+coverage, staging bounds), post-reshard behavior (exchange/steps/tuner
+re-key), and the structural-impossibility errors the supervisor's
+fallback keys on.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.io.checkpoint import restore_checkpoint, save_checkpoint
+from stencil_tpu.parallel.redistribute import (
+    ReshardImpossibleError,
+    SideGeometry,
+    plan_redistribution,
+)
+
+TOL = dict(rtol=1e-6, atol=1e-6)
+
+
+def _mk(devs, size=(16, 16, 16), mult=1, storage=None,
+        fields=(("q", jnp.float32, ()),), radius=1):
+    dd = DistributedDomain(*size)
+    dd.set_radius(Radius.constant(radius))
+    dd.set_devices(devs)
+    if mult > 1:
+        dd.set_halo_multiplier(mult)
+    if storage:
+        dd.set_storage(storage)
+    hs = [dd.add_data(n, dtype=dt, components=c) for n, dt, c in fields]
+    dd.realize()
+    for i, h in enumerate(hs):
+        dd.init_by_coords(
+            h, lambda x, y, z, i=i: jnp.sin(0.13 * (x + 2 * y + 3 * z) + i)
+        )
+    return dd, hs
+
+
+def mean6_kernel(views, info):
+    out = {}
+    for name, src in views.items():
+        out[name] = (
+            src.sh(-1, 0, 0) + src.sh(1, 0, 0)
+            + src.sh(0, -1, 0) + src.sh(0, 1, 0)
+            + src.sh(0, 0, -1) + src.sh(0, 0, 1)
+        ) / 6.0
+    return out
+
+
+# --- the plan ----------------------------------------------------------------
+
+
+class TestPlan:
+    def _plan(self, n_src=8, n_dst=4, size=(16, 16, 16)):
+        devs = jax.devices()
+        src_dd, _ = _mk(devs[:n_src], size)
+        dst_dd, _ = _mk(devs[:n_dst], size)
+        return plan_redistribution(
+            size,
+            SideGeometry.of_domain(src_dd),
+            SideGeometry.of_domain(dst_dd),
+        )
+
+    def test_rounds_are_permutations(self):
+        """Every round has unique senders and unique receivers — the
+        ppermute constraint the schedule is built on."""
+        plan = self._plan()
+        assert plan.rounds
+        for rnd in plan.rounds:
+            srcs = [s for s, _ in rnd.pairs]
+            dsts = [d for _, d in rnd.pairs]
+            assert len(set(srcs)) == len(srcs)
+            assert len(set(dsts)) == len(dsts)
+
+    def test_chunks_cover_the_domain_exactly_once(self):
+        """The union of received extents per target shard tiles its valid
+        interior with no overlap — conservation of cells."""
+        size = (17, 17, 17)
+        plan = self._plan(8, 2, size)
+        total = 0
+        for rnd in plan.rounds:
+            for _, dst in rnd.pairs:
+                total += int(np.prod(rnd.recv_size[dst]))
+        assert total == int(np.prod(size))
+
+    def test_staging_never_exceeds_a_shard(self):
+        plan = self._plan(2, 8)
+        src_raw = plan.src.raw
+        dst_raw = plan.dst.raw
+        for rnd in plan.rounds:
+            for a in range(3):
+                assert rnd.staging[a] <= max(src_raw[a], dst_raw[a])
+
+    def test_bound_is_a_constant_multiple_of_the_block(self):
+        plan = self._plan()
+        blk = max(int(np.prod(plan.src.raw)), int(np.prod(plan.dst.raw)))
+        assert plan.bound_bytes(4) == 3 * blk * 4
+
+
+# --- reshard-vs-restore equivalence matrix -----------------------------------
+
+
+MATRIX = [
+    # (label, size, n_src, n_dst, mult, storage, fields)
+    ("shrink", (16, 16, 16), 8, 4, 1, None, (("q", jnp.float32, ()),)),
+    ("grow", (16, 16, 16), 2, 8, 1, None, (("q", jnp.float32, ()),)),
+    ("uneven-shrink", (17, 17, 17), 8, 4, 1, None, (("q", jnp.float32, ()),)),
+    ("uneven-grow-mult2", (17, 17, 17), 2, 8, 2, None, (("q", jnp.float32, ()),)),
+    ("halo-mult-shells", (16, 16, 16), 2, 8, 2, None, (("q", jnp.float32, ()),)),
+    ("bf16-storage", (16, 16, 16), 8, 4, 1, "bf16", (("q", jnp.float32, ()),)),
+    (
+        "fused-multi-dtype",
+        (16, 16, 16),
+        4,
+        8,
+        1,
+        None,
+        (("a", jnp.float32, ()), ("b", jnp.float64, ()), ("c", jnp.int8, ())),
+    ),
+    ("components", (16, 16, 16), 8, 2, 1, None, (("v", jnp.float32, (3,)),)),
+]
+
+
+@pytest.mark.parametrize(
+    "label,size,n_src,n_dst,mult,storage,fields",
+    MATRIX,
+    ids=[m[0] for m in MATRIX],
+)
+def test_reshard_bitwise_equals_elastic_restore(
+    tmp_path, label, size, n_src, n_dst, mult, storage, fields
+):
+    """THE equivalence pin: the in-memory collective lands the exact raw
+    arrays (stored dtype, zero shells, valid interiors) the PR-8
+    checkpoint-elastic-restore path produces."""
+    devs = jax.devices()
+    dd, hs = _mk(devs[:n_src], size, mult, storage, fields)
+    stats = dd.reshard(devices=devs[:n_dst])
+    assert stats["from_mesh"] != stats["to_mesh"]
+    # the disk twin: save on mesh A, restore into a fresh mesh-B domain
+    dd_a, _ = _mk(devs[:n_src], size, mult, storage, fields)
+    dd_b, hs_b = _mk(devs[:n_dst], size, mult, storage, fields)
+    save_checkpoint(dd_a, str(tmp_path / "ck"), backend="npz")
+    restore_checkpoint(dd_b, str(tmp_path / "ck"))
+    for h in hs:
+        got = np.asarray(dd.get_curr(h))
+        want = np.asarray(dd_b.get_curr(h))
+        assert got.dtype == want.dtype and got.shape == want.shape
+        np.testing.assert_array_equal(got, want, err_msg=label)
+
+
+# --- post-reshard behavior ----------------------------------------------------
+
+
+class TestPostReshard:
+    def test_steps_on_the_new_mesh_match_a_native_run(self):
+        """After a shrink, rebuilt steps advance bitwise-identically to a
+        domain that lived on the target mesh all along."""
+        devs = jax.devices()
+        dd, (h,) = _mk(devs[:8])
+        dd.reshard(devices=devs[:4])
+        step = dd.make_step(mean6_kernel)
+        dd.run_step(step, 2)
+        ref, (h_ref,) = _mk(devs[:4])
+        ref_step = ref.make_step(mean6_kernel)
+        ref.run_step(ref_step, 2)
+        np.testing.assert_array_equal(
+            dd.quantity_to_host(h), ref.quantity_to_host(h_ref)
+        )
+
+    def test_exchange_works_and_route_re_resolves(self):
+        devs = jax.devices()
+        dd, (h,) = _mk(devs[:2])
+        dd.reshard(devices=devs[:8])
+        dd.exchange()  # must not raise on the new geometry
+        assert dd.exchange_route() == "direct"
+
+    def test_tuner_re_keyed_by_the_new_mesh(self):
+        devs = jax.devices()
+        dd, _ = _mk(devs[:8])
+        before = dd.tune_key("exchange")
+        dd.reshard(devices=devs[:4])
+        after = dd.tune_key("exchange")
+        assert before.mesh == (2, 2, 2) and after.mesh == (2, 2, 1)
+
+    def test_telemetry_counters_and_event(self):
+        from stencil_tpu import telemetry
+
+        devs = jax.devices()
+        dd, _ = _mk(devs[:4])
+        before = telemetry.snapshot()["counters"]["reshard.count"]
+        dd.reshard(devices=devs[:2])
+        snap = telemetry.snapshot()["counters"]
+        assert snap["reshard.count"] == before + 1
+        assert snap["reshard.bytes"] >= 16 * 16 * 16 * 4
+
+    def test_same_devices_is_a_valid_noop_move(self):
+        """Resharding onto the identical mesh is legal (the supervisor
+        filters no-ops, but the primitive must not care)."""
+        devs = jax.devices()
+        dd, (h,) = _mk(devs[:4])
+        want = dd.quantity_to_host(h)
+        dd.reshard(devices=devs[:4])
+        np.testing.assert_array_equal(dd.quantity_to_host(h), want)
+
+
+# --- structural impossibility -------------------------------------------------
+
+
+class TestImpossible:
+    def test_inadmissible_partition_raises_and_preserves_state(self):
+        """A target mesh whose shards cannot hold the shell raises the
+        classified error and leaves the domain fully on its old mesh."""
+        devs = jax.devices()
+        dd, (h,) = _mk(devs[:2], size=(8, 8, 8), mult=2)
+        want = dd.quantity_to_host(h)
+        with pytest.raises(ReshardImpossibleError, match="admissible"):
+            # 8 cells over 8 z-shards = 1-wide shards < the 2-wide shell
+            dd.reshard(devices=devs[:8], force_dim=(1, 1, 8))
+        assert dd.mesh_dim() == (2, 1, 1) or dd.mesh_dim() == (1, 1, 2) \
+            or dd.mesh_dim() == (1, 2, 1)
+        np.testing.assert_array_equal(dd.quantity_to_host(h), want)
+
+    def test_consumed_buffers_refuse_redistribution(self):
+        """A donated (deleted) source buffer is 'devices already gone' in
+        miniature: reshard refuses with the classified error the
+        supervisor's fallback keys on."""
+        devs = jax.devices()
+        dd, (h,) = _mk(devs[:2])
+        step = dd.make_step(mean6_kernel, donate=True)
+        arr = dd.get_curr(h)
+        dd.run_step(step, 1)  # donates the old curr
+        assert arr.is_deleted()
+        dd._curr[h.name] = arr  # simulate the mid-dispatch wreckage
+        with pytest.raises(ReshardImpossibleError, match="consumed"):
+            dd.reshard(devices=devs[:1])
+
+    def test_force_dim_pin_survives_a_mid_collective_failure(self, monkeypatch):
+        """A failure AFTER geometry planning (mid-collective) must leave
+        the domain — including a set_partition pin — exactly as it was:
+        a silently cleared pin would re-derive a different mesh at the
+        next realize/restore."""
+        devs = jax.devices()
+        dd = DistributedDomain(16, 16, 16)
+        dd.set_radius(Radius.constant(1))
+        dd.set_devices(devs[:4])
+        dd.set_partition(2, 2, 1)
+        h = dd.add_data("q")
+        dd.realize()
+        dd.init_by_coords(h, lambda x, y, z: jnp.sin(0.1 * x + y + z))
+        pinned = dd._force_dim
+
+        def boom(*a, **k):
+            raise RuntimeError("transient backend failure mid-collective")
+
+        from stencil_tpu.parallel import redistribute as r
+
+        monkeypatch.setattr(r, "redistribute_array", boom)
+        with pytest.raises(RuntimeError, match="mid-collective"):
+            dd.reshard(devices=devs[:8])
+        assert dd._force_dim == pinned and dd.mesh_dim() == (2, 2, 1)
+        dd.exchange()  # the old mesh still fully works
+
+    def test_re_realize_discards_state_onto_the_new_mesh(self):
+        """The fallback's first half: fresh zero fields on the target
+        mesh, ready for restore_checkpoint."""
+        devs = jax.devices()
+        dd, (h,) = _mk(devs[:8])
+        dd.re_realize(devices=devs[:2])
+        assert dd.mesh_dim() in ((2, 1, 1), (1, 2, 1), (1, 1, 2))
+        assert float(np.abs(dd.quantity_to_host(h)).max()) == 0.0

@@ -112,6 +112,38 @@ class TestClassify:
         assert classify(ValueError("shape mismatch")) is FailureClass.FATAL
         assert classify(KeyError("temp")) is FailureClass.FATAL
 
+    def test_capacity_loss_wordings_pinned(self):
+        """The CURRENT device-unavailable / slice-health texts.  These
+        route to the supervisor's reshard/restore path — a toolchain
+        upgrade that re-words one must fail here, not silently fall back
+        to FATAL (losing the elastic-capacity recovery)."""
+        for msg in (
+            "UNAVAILABLE: TPU is unhealthy: lost device at coordinates [0,1,0]",
+            "FAILED_PRECONDITION: The TPU slice health check failed: "
+            "worker 3 unreachable",
+            "INTERNAL: Device coordinator reported missing chips after "
+            "preemption notice",
+            "a device has been removed from the fleet",
+        ):
+            assert classify(RuntimeError(msg)) is FailureClass.CAPACITY_LOSS, msg
+
+    def test_capacity_loss_beats_the_transient_markers(self):
+        """THE ordering pin: real device-loss wordings carry the gRPC
+        'UNAVAILABLE:' prefix — they must classify CAPACITY_LOSS, never
+        TRANSIENT (a blind retry against a missing chip re-fails forever),
+        while a plain UNAVAILABLE stays retryable."""
+        loss = "UNAVAILABLE: TPU is unhealthy: lost device at coordinates"
+        assert classify(RuntimeError(loss)) is FailureClass.CAPACITY_LOSS
+        assert (
+            classify(RuntimeError("UNAVAILABLE: Socket closed"))
+            is FailureClass.TRANSIENT_RUNTIME
+        )
+
+    def test_capacity_loss_never_degrades(self):
+        from stencil_tpu.resilience.taxonomy import is_degradable
+
+        assert not is_degradable(FailureClass.CAPACITY_LOSS)
+
     def test_preemption_never_transient(self):
         """THE preemption pin: KeyboardInterrupt / SIGTERM-driven
         termination classifies PREEMPTED, so the retry loop can never
@@ -244,6 +276,42 @@ class TestFaultPlan:
         p = inject.FaultPlan.parse("dispatch:sigkill:jacobi@7,dispatch:sigterm:x*2")
         assert p.pending() == 3
         p.fire("dispatch", "other")  # label mismatch: nothing fires
+
+    def test_injected_capacity_loss_classifies(self):
+        """The capacity_loss class raises the real device-unhealthy
+        wording: classify routes it to CAPACITY_LOSS, exercising the
+        supervisor's reshard/restore path like the real thing."""
+        from stencil_tpu.resilience.taxonomy import FailureClass, classify
+
+        p = inject.FaultPlan.parse("dispatch:capacity_loss:jacobi*1")
+        with pytest.raises(RuntimeError, match="unhealthy") as ei:
+            p.fire("dispatch", "jacobi")
+        assert classify(ei.value) is FailureClass.CAPACITY_LOSS
+
+    def test_capacity_notices_call_the_registered_handler(self):
+        """shrink/grow are NOTICES, not failures: the registered handler
+        (the supervisor) records them and the dispatch proceeds; with no
+        handler they are logged and dropped, never raised."""
+        seen = []
+        prev = inject.set_capacity_handler(
+            lambda kind, phase, label: seen.append((kind, phase, label))
+        )
+        try:
+            p = inject.FaultPlan.parse(
+                "dispatch:shrink:jacobi@1,dispatch:grow:jacobi@1"
+            )
+            p.fire("dispatch", "jacobi")  # both entries pass through
+            p.fire("dispatch", "jacobi")  # shrink fires (no raise)
+            p.fire("dispatch", "jacobi")  # grow fires
+            assert seen == [
+                ("shrink", "dispatch", "jacobi"),
+                ("grow", "dispatch", "jacobi"),
+            ]
+        finally:
+            inject.set_capacity_handler(prev)
+        # no handler: the notice is dropped without raising
+        p = inject.FaultPlan.parse("dispatch:shrink:x*1")
+        p.fire("dispatch", "x")
 
     def test_env_plan_reparsed_on_change(self, monkeypatch):
         monkeypatch.setenv("STENCIL_FAULT_PLAN", "dispatch:fatal*1")

@@ -277,6 +277,176 @@ class TestSupervisor:
             assert name in snap["counters"], name
 
 
+# --- elastic capacity --------------------------------------------------------
+
+
+class TestElasticCapacity:
+    """The drain-and-reshard path (docs/resilience.md "Elastic capacity"):
+    seeded shrink/grow notices reshard the live domain in memory at chunk
+    boundaries, classified CAPACITY_LOSS routes to reshard-or-restore,
+    the fallback charges the restart budget, and everything stays bitwise
+    identical to the untouched run."""
+
+    def _sup(self, tmp_path, m, **kw):
+        return RunSupervisor(
+            m.dd, _config(tmp_path, **kw), label="jacobi",
+            on_mesh_change=m.rebuild_after_reshard,
+        )
+
+    def test_shrink_notice_drains_and_reshards_bitwise(self, tmp_path):
+        """A seeded shrink notice: one in-memory transition, no restart
+        budget charged, no disk restore, final field bitwise identical to
+        the unkilled full-mesh run."""
+        want = _model(12).temperature()
+        m = _model()
+        sup = self._sup(tmp_path, m)
+        inject.set_plan("dispatch:shrink:jacobi@5")
+        out = sup.run(12, advance=lambda n: m.step(n), chunk=1)
+        assert out.completed and out.restarts == 0
+        assert [t["kind"] for t in sup.mesh_history] == ["reshard"]
+        assert sup.mesh_history[0]["from"] == [2, 2, 2]
+        assert m.dd.mesh_dim() == (2, 2, 1)
+        np.testing.assert_array_equal(m.temperature(), want)
+
+    def test_capacity_loss_reshards_in_process_then_grows_back(self, tmp_path):
+        """A queued shrink target followed by a classified CAPACITY_LOSS:
+        the loss reshards onto the pending target in-process (state is
+        trustworthy — single-dispatch chunk, live buffers), and a second
+        loss with no pending target re-fits to the full fleet.  Zero
+        budget charged, still bitwise."""
+        want = _model(12).temperature()
+        m = _model()
+        sup = self._sup(tmp_path, m)
+        inject.set_plan(
+            "dispatch:shrink:jacobi@3,dispatch:capacity_loss:jacobi@7"
+        )
+        out = sup.run(12, advance=lambda n: m.step(n), chunk=1)
+        assert out.completed and out.restarts == 0
+        assert [t["kind"] for t in sup.mesh_history] == ["reshard", "reshard"]
+        assert m.dd.mesh_dim() == (2, 2, 2)  # grown back to the full fleet
+        np.testing.assert_array_equal(m.temperature(), want)
+
+    def test_capacity_loss_mid_chunk_falls_back_to_restore(self, tmp_path):
+        """A CAPACITY_LOSS inside a multi-dispatch chunk leaves the step
+        counter untrustworthy: the recorded fallback is checkpoint-
+        elastic-restore, charged against the restart budget — still
+        bitwise after completion."""
+        want = _model(12).temperature()
+        m = _model()
+        sup = self._sup(tmp_path, m, max_restarts=2)
+        inject.set_plan("dispatch:capacity_loss:jacobi@5")
+        out = sup.run(12, advance=lambda n: m.step(n), chunk=2)
+        assert out.completed and out.restarts == 1
+        assert [t["kind"] for t in sup.mesh_history] == ["restore"]
+        snap = telemetry.snapshot()["counters"]
+        assert snap["reshard.fallbacks"] >= 1
+        np.testing.assert_array_equal(m.temperature(), want)
+
+    def test_capacity_loss_is_never_blindly_retried(self, tmp_path):
+        """With no restart budget and no checkpoint to fall back on, a
+        mid-chunk capacity loss PROPAGATES (classified) — it must never
+        loop through the transient retry path."""
+        m = _model()
+        sup = self._sup(tmp_path, m, max_restarts=0)
+        inject.set_plan("dispatch:capacity_loss:jacobi@3")
+        with pytest.raises(RuntimeError, match="unhealthy"):
+            sup.run(12, advance=lambda n: m.step(n), chunk=2)
+        # the class routes to reshard/restore, never the retry loop
+        assert classify(RuntimeError("TPU is unhealthy")) is (
+            FailureClass.CAPACITY_LOSS
+        )
+
+    def test_repeated_capacity_loss_exhausts_instead_of_spinning(self, tmp_path):
+        """On real hardware a dead chip never leaves jax.devices(), so a
+        capacity loss on the full fleet looks like a no-op refit.  The
+        first loss may continue in place; a REPEAT with no healthy chunk
+        between must route through the budget-bounded fallback — and run
+        out — never re-dispatch against the dead chip forever."""
+        m = _model()
+        sup = self._sup(tmp_path, m, max_restarts=1)
+        inject.set_plan("dispatch:capacity_loss:jacobi@3*5")
+        with pytest.raises(RuntimeError, match="unhealthy"):
+            sup.run(12, advance=lambda n: m.step(n), chunk=1)
+        # one in-place continue, one budgeted fallback, then exhaustion
+        assert sup._restarts == 1
+        assert [t["kind"] for t in sup.mesh_history] == ["restore"]
+
+    def test_heartbeat_carries_mesh_and_transitions(self, tmp_path, capsys):
+        m = _model()
+        sup = self._sup(tmp_path, m)
+        inject.set_plan("dispatch:shrink:jacobi@2")
+        out = sup.run(8, advance=lambda n: m.step(n), chunk=1)
+        assert out.completed
+        status = json.load(
+            open(os.path.join(str(tmp_path / "ring"), "status.json"))
+        )
+        assert status["mesh"] == [2, 2, 1]
+        assert status["mesh_transitions"] == 1
+        assert status["mesh_history"][0]["kind"] == "reshard"
+        # the status renderer shows the transition
+        from stencil_tpu.status import main as status_main
+
+        assert status_main([str(tmp_path / "ring")]) == 0
+        rendered = capsys.readouterr().out
+        assert "mesh 2x2x1" in rendered
+        assert "mesh reshard" in rendered and "2x2x2 -> 2x2x1" in rendered
+
+
+class TestRestartBudgetReplenish:
+    """STENCIL_RESTART_WINDOW: sustained healthy progress restores spent
+    restart credits — a week-long run must not exhaust a lifetime budget
+    on early transients."""
+
+    def test_replenished_credit_allows_a_later_restart(self, tmp_path):
+        """Budget 1, window 3: a fatal early and a fatal late both restart
+        (the healthy stretch between them replenished the credit), and the
+        run still completes bitwise."""
+        want = _model(16).temperature()
+        m = _model()
+        cfg = _config(
+            tmp_path, every_steps=2, max_restarts=1, restart_window=3
+        )
+        sup = RunSupervisor(m.dd, cfg, label="jacobi")
+        inject.set_plan(
+            "dispatch:fatal:jacobi@2*1,dispatch:fatal:jacobi@9*1"
+        )
+        out = sup.run(16, advance=lambda n: m.step(n), chunk=1)
+        assert out.completed and out.restarts == 2  # the COUNT keeps growing
+        np.testing.assert_array_equal(m.temperature(), want)
+
+    def test_without_a_window_the_same_plan_exhausts(self, tmp_path):
+        m = _model()
+        cfg = _config(tmp_path, every_steps=2, max_restarts=1)
+        sup = RunSupervisor(m.dd, cfg, label="jacobi")
+        inject.set_plan(
+            "dispatch:fatal:jacobi@2*1,dispatch:fatal:jacobi@9*1"
+        )
+        with pytest.raises(RuntimeError, match="injected fatal"):
+            sup.run(16, advance=lambda n: m.step(n), chunk=1)
+
+    def test_failures_reset_the_healthy_streak(self, tmp_path):
+        """Back-to-back fatals inside one window must both charge the
+        budget — the streak resets on every classified failure, so two
+        quick failures exhaust a budget of 1 even with a window."""
+        m = _model()
+        cfg = _config(
+            tmp_path, every_steps=2, max_restarts=1, restart_window=4
+        )
+        sup = RunSupervisor(m.dd, cfg, label="jacobi")
+        inject.set_plan("dispatch:fatal:jacobi@2*1,dispatch:fatal:jacobi@4*1")
+        with pytest.raises(RuntimeError, match="injected fatal"):
+            sup.run(16, advance=lambda n: m.step(n), chunk=1)
+
+    def test_window_env_knob(self, monkeypatch):
+        monkeypatch.setenv("STENCIL_CHECKPOINT_DIR", "/tmp/x")
+        monkeypatch.setenv("STENCIL_RESTART_WINDOW", "12")
+        cfg = SupervisorConfig.from_env()
+        assert cfg.restart_window == 12
+        monkeypatch.setenv("STENCIL_RESTART_WINDOW", "sometimes")
+        with pytest.raises(ValueError, match="STENCIL_RESTART_WINDOW"):
+            SupervisorConfig.from_env()
+
+
 # --- flight recorder ---------------------------------------------------------
 
 
@@ -480,3 +650,48 @@ def test_run_soak_kill_resume_chain():
     signals = {k["signal"] for k in doc["kills"]}
     assert signals == {"sigkill", "sigterm"}
     assert doc["final_step"]["chaos"] == doc["final_step"]["ref"] == 12
+
+
+@pytest.mark.slow
+def test_run_soak_reshard_transitions():
+    """The elastic-capacity chaos proof: scripts/run_soak.py --reshard
+    --dryrun — >= 2 seeded grow/shrink transitions (in-memory
+    drain-and-reshard, both directions) interleaved with the SIGKILL/
+    SIGTERM kills, final digests bitwise identical to the unkilled
+    full-capacity reference, per-transition reshard timings recorded for
+    the perf ledger's `reshard:seconds` series."""
+    import tempfile
+
+    out_dir = tempfile.mkdtemp(prefix="stencil_soak_reshard_test_")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "run_soak.py"),
+            "--dryrun",
+            "--reshard",
+            "--iters",
+            "12",
+            "--checkpoint-every",
+            "3",
+            "--kills",
+            "3",
+            "--out-dir",
+            out_dir,
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    doc = json.loads(open(os.path.join(out_dir, "soak_summary.json")).read())
+    assert doc["bitwise_identical"] is True
+    assert doc["reshard"] is True
+    reshards = [t for t in doc["transitions"] if t["kind"] == "reshard"]
+    assert len(reshards) >= 2
+    # both directions moved in memory
+    dirs = {(tuple(t["from"]), tuple(t["to"])) for t in reshards}
+    assert ((2, 1, 1), (1, 1, 1)) in dirs and ((1, 1, 1), (2, 1, 1)) in dirs
+    assert all(t["seconds"] > 0 for t in reshards)
+    assert len(doc["reshard_seconds"]) == len(reshards)
+    assert doc["recovery_seconds"] > 0

@@ -189,6 +189,62 @@ def mxu_vs_vpu_ab(size: int, k: int, interpret: bool, rt: float,
     return section
 
 
+def numerics_overhead_ab(size: int, interpret: bool, rt: float,
+                         reps: int = 3, inner: int = None) -> dict:
+    """Steady-state numerics-observatory on/off A/B on the headline
+    workload: the SAME wrap-route jacobi model stepped with the fused
+    field-health snapshot cadence at every dispatch vs fully off,
+    alternating in ONE process under the trial protocol (rep-0 drop,
+    steady-state median).  The T3 claim (arxiv 2401.16677) this layer is
+    built on is "cheap enough to leave enabled in production";
+    ``scripts/perf_ledger.py`` ingests the per-snapshot cost as the
+    LOWER-is-better ``numerics:overhead`` series, so the claim is
+    regression-gated across rounds instead of asserted once.  Returns the
+    JSON section."""
+    import statistics as _stats
+
+    import jax
+
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.tune.trial import measure_alternating
+
+    model = Jacobi3D(size, size, size, devices=[jax.devices()[0]],
+                     kernel_impl="pallas", interpret=interpret)
+    model.realize()
+
+    def make_run(every):
+        def run(n):
+            model.dd.set_numerics_every(every)
+            model.step(n)
+            model.block_until_ready()
+        return run
+
+    if inner is None:
+        inner = 25 if size >= 256 else 2
+    runs = [make_run(0), make_run(inner)]  # off / one snapshot per dispatch
+    for run in runs:
+        run(inner)  # warm + compile (the on leg also compiles the stats fn)
+    rounds = measure_alternating(runs, inner, rt, reps)
+    model.dd.set_numerics_every(0)
+    off = _stats.median(rounds[0])  # seconds per raw iteration
+    on = _stats.median(rounds[1])
+    snapshot_ms = max(on - off, 0.0) * inner * 1e3  # one snapshot per dispatch
+    return {
+        "off_ms_per_iter": round(off * 1e3, 4),
+        "on_ms_per_iter": round(on * 1e3, 4),
+        "snapshot_ms": round(snapshot_ms, 4),
+        "overhead_frac_per_dispatch": round(
+            (on - off) / off if off > 0 else 0.0, 4
+        ),
+        "snapshots_per_dispatch": 1,
+        "iters_per_dispatch": inner,
+        "quantities": 1,
+        "measurement_protocol": {
+            "alternating": True, "drop_rep0": True, "stat": "median",
+        },
+    }
+
+
 def build_parser():
     """Flag surface (the no-flag invocation is byte-identical to the
     historical ``python bench.py``): ``--ledger`` appends the measured
@@ -356,6 +412,17 @@ def main(argv=None) -> None:
         print(f"mxu_vs_vpu section failed (recorded null): {e!r}",
               file=sys.stderr)
 
+    # the numerics-observatory on/off A/B ("cheap enough to leave on" —
+    # docs/observability.md 'Numerics observatory'): same rule, a failure
+    # records null and never costs the headline fields
+    numerics_ab = None
+    try:
+        numerics_ab = numerics_overhead_ab(size, interpret, rt,
+                                           reps=3 if full else 1)
+    except Exception as e:  # noqa: BLE001 — an A/B accelerator, not a dep
+        print(f"numerics_overhead section failed (recorded null): {e!r}",
+              file=sys.stderr)
+
     # copy bandwidth BEFORE the astaroth section: it feeds the headline
     # roofline fields, which must be complete even if astaroth fails
     copy_gbps = measured_copy_gbps(rt, n=514 if full else size + 2,
@@ -381,6 +448,10 @@ def main(argv=None) -> None:
         "compute_unit": headline_unit,
         "storage_dtype": headline_storage,
         "mxu_vs_vpu": mxu_ab,
+        # the numerics observatory's on/off A/B: per-snapshot cost of the
+        # fused on-device field-health dispatch, regression-gated by the
+        # ledger's LOWER-is-better numerics:overhead series
+        "numerics_overhead": numerics_ab,
         # the autotuner's decision for this workload: cache hit/miss, trials
         # run (0 on a warm cache), pruned candidates, the winning config,
         # and the search's steady-state numbers for winner vs static

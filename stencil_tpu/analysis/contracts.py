@@ -26,6 +26,13 @@ walker or a source-level heuristic the tracer can defeat:
   stays under a constant multiple of the shard size, and no gathering
   collective (all_gather / all_to_all) appears anywhere.  A full-gather
   "redistribution" would pass every numeric test and OOM only at scale.
+* ``numerics-bounded``    — the numerics observatory's headline claim
+  (``telemetry/numerics.py``): the fused field-stats program reduces
+  on-device (psum/pmin/pmax inside the shard_map) and ships
+  O(#quantities) scalars — scalar-only outputs under the per-quantity
+  budget, no gathering collective anywhere.  A per-quantity host gather
+  would pass every numeric test and silently reintroduce the PR-1
+  sentinel's device→host cost.
 * ``donation-soundness``  — the jaxpr-level twin of the ``donated-reuse``
   lint rule: a donated/aliased buffer must be dead after the call.
 * ``accum-dtype``         — every contraction in a kernel jaxpr pins an
@@ -484,6 +491,91 @@ class RedistributeBounded(Contract):
                     self.name,
                     "multi-rank redistribution program issues no ppermute — "
                     "nothing actually moves through the collective schedule",
+                )
+            )
+        return out
+
+
+#: in-program reducing collectives — what the numerics stats program must
+#: use instead of gathering (psum spells itself psum2 on current jax)
+_REDUCING_PRIMITIVES = frozenset({"psum", "psum2", "pmin", "pmax"})
+
+
+@register
+class NumericsBounded(Contract):
+    name = "numerics-bounded"
+    why = (
+        "the fused numerics stats program reduces on-device and ships "
+        "O(#quantities) SCALARS to the host: every traced output is a "
+        "0-d scalar, the output count is bounded by the per-quantity "
+        "scalar budget, no gathering collective appears anywhere, and a "
+        "multi-device program really reduces with psum/pmin/pmax — a "
+        "per-quantity host gather would pass every numeric test and "
+        "silently reintroduce the PR-1 sentinel's cost "
+        "(telemetry/numerics.py, arxiv 2401.16677)"
+    )
+
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        return art.kind == "numerics"
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import jaxpr as jx
+        from stencil_tpu.telemetry.numerics import SCALARS_PER_QUANTITY
+
+        out: List[Finding] = []
+        nq = art.meta.get("n_quantities")
+        if not isinstance(nq, int) or nq <= 0:
+            return [
+                art.finding(
+                    self.name,
+                    "numerics artifact carries no meta['n_quantities'] — "
+                    "the scalar-output bound cannot be verified",
+                )
+            ]
+        jaxpr = getattr(art.closed, "jaxpr", art.closed)
+        outvars = list(jaxpr.outvars)
+        if len(outvars) > SCALARS_PER_QUANTITY * nq:
+            out.append(
+                art.finding(
+                    self.name,
+                    f"{len(outvars)} outputs for {nq} quantities (> the "
+                    f"{SCALARS_PER_QUANTITY}/quantity scalar budget) — the "
+                    "host transfer is no longer O(#quantities)",
+                )
+            )
+        for v in outvars:
+            shape = tuple(getattr(getattr(v, "aval", None), "shape", ()))
+            if shape != ():
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"output with shape {shape} — the numerics program "
+                        "must ship scalars, never arrays (a shaped output "
+                        "is a gather in disguise)",
+                    )
+                )
+        saw_reduce = False
+        for e in jx.iter_eqns(art.closed):
+            if e.primitive.name in _GATHERING_PRIMITIVES:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"{e.primitive.name} (scope "
+                        f"{jx.name_stack_str(e)!r}) — a gathering "
+                        "collective in the stats program materializes "
+                        "whole fields; reduce with psum/pmin/pmax instead",
+                    )
+                )
+            if e.primitive.name in _REDUCING_PRIMITIVES:
+                saw_reduce = True
+        if art.n_devices > 1 and not saw_reduce:
+            out.append(
+                art.finding(
+                    self.name,
+                    "multi-device numerics program issues no reducing "
+                    "collective (psum/pmin/pmax) — per-shard stats were "
+                    "never combined, so the scalars describe one shard, "
+                    "not the domain",
                 )
             )
         return out

@@ -64,7 +64,7 @@ class ProgramSpec:
     """One canonical program: what to build and which axes it exercises."""
 
     label: str
-    kind: str = "step"  # "step" | "exchange" | "redistribute"
+    kind: str = "step"  # "step" | "exchange" | "redistribute" | "numerics"
     size: tuple = (16, 16, 16)
     n_devices: int = MATRIX_DEVICES
     halo_mult: int = 1
@@ -188,6 +188,19 @@ CANONICAL_PROGRAMS: List[ProgramSpec] = [
         kind="exchange",
         halo_mult=2,
         exchange_route="yzpack_pallas",
+    ),
+    # the numerics observatory's fused stats program (telemetry/numerics.py)
+    # on its hardest geometry: an UNEVEN halo-multiplier multi-quantity
+    # domain — pad-and-mask validity masking, mult-2 shell offsets, and two
+    # quantities through one dispatch.  The numerics-bounded contract holds
+    # the scalar-outputs / no-gather / psum-reduced claims on exactly the
+    # program the sentinel and the snapshot cadence dispatch.
+    ProgramSpec(
+        "numerics:stats/uneven",
+        kind="numerics",
+        size=(17, 17, 17),
+        halo_mult=2,
+        n_fields=2,
     ),
     # the elastic-capacity collective (parallel/redistribute.py): a shrink
     # of an UNEVEN halo-multiplier domain from the full 8-chip mesh onto 4
@@ -317,10 +330,32 @@ def _redistribute_artifact(spec: ProgramSpec, dd) -> ProgramArtifact:
     )
 
 
+def _numerics_artifact(spec: ProgramSpec, dd) -> ProgramArtifact:
+    """Trace the fused numerics stats program — exactly the jitted
+    callable ``NumericsEngine.snapshot`` dispatches — with the quantity
+    count in ``meta`` for the scalar-output bound."""
+    import jax
+
+    from stencil_tpu.telemetry.numerics import NumericsEngine
+
+    fn, args, names = NumericsEngine(dd).program()
+    closed = jax.make_jaxpr(fn)(*args)
+    return ProgramArtifact(
+        label=spec.label,
+        kind="numerics",
+        closed=closed,
+        dd=dd,
+        n_devices=spec.n_devices,
+        meta={"n_quantities": len(names)},
+    )
+
+
 def build_program(spec: ProgramSpec) -> ProgramArtifact:
     """Really build and trace one canonical program (interpret/CPU mode)."""
     with tpu_shaped_trace():
         dd = _build_domain(spec)
+        if spec.kind == "numerics":
+            return _numerics_artifact(spec, dd)
         if spec.kind == "redistribute":
             return _redistribute_artifact(spec, dd)
         if spec.kind == "exchange":

@@ -277,6 +277,34 @@ def add_stream_halo_flag(p: argparse.ArgumentParser) -> None:
     )
 
 
+def add_numerics_flag(p: argparse.ArgumentParser) -> None:
+    """``--numerics-every``: the numerics observatory's snapshot cadence
+    (docs/observability.md "Numerics observatory").  Every N raw steps ONE
+    fused on-device dispatch computes per-quantity interior health
+    (min/max/absmax/mean/L2/non-finite count + first-non-finite
+    coordinate), lands it in the snapshot ring (heartbeats and crash
+    reports carry it), and runs the registered invariant guardbands —
+    observe-only unless ``STENCIL_NUMERICS_ABORT=1``.  Unset falls back to
+    ``STENCIL_NUMERICS_EVERY``; 0 disables."""
+    p.add_argument(
+        "--numerics-every",
+        type=int,
+        default=None,
+        metavar="N",
+        help="fused on-device field-health snapshot every N raw steps "
+        "(default: STENCIL_NUMERICS_EVERY; 0 = off; see "
+        "docs/observability.md 'Numerics observatory')",
+    )
+
+
+def apply_numerics(args, dd) -> None:
+    """Apply ``add_numerics_flag``'s choice to a domain (the env default
+    is already read by the domain's constructor)."""
+    every = getattr(args, "numerics_every", None)
+    if every is not None:
+        dd.set_numerics_every(max(every, 0))
+
+
 def add_checkpoint_flags(p: argparse.ArgumentParser) -> None:
     """Long-run survival knobs shared by the model drivers
     (docs/resilience.md "Long-run operation"): ``--checkpoint-dir`` turns

@@ -61,6 +61,7 @@ def main(argv=None) -> int:
     _common.add_stream_halo_flag(p)
     _common.add_exchange_route_flag(p)
     _common.add_kernel_axis_flags(p)
+    _common.add_numerics_flag(p)
     _common.add_checkpoint_flags(p)
     args = p.parse_args(argv)
     _common.telemetry_begin(args)
@@ -135,6 +136,7 @@ def _run(args) -> int:
         ),
         **_common.kernel_axis_kwargs(args),
     )
+    _common.apply_numerics(args, sim.dd)
     sim.realize()
 
     iter_time = Statistics()

@@ -67,6 +67,7 @@ def main(argv=None) -> int:
     _common.add_tune_flags(p)
     _common.add_exchange_route_flag(p)
     _common.add_kernel_axis_flags(p)
+    _common.add_numerics_flag(p)
     _common.add_checkpoint_flags(p)
     p.add_argument("x", type=int, nargs="?", default=512)
     p.add_argument("y", type=int, nargs="?", default=512)
@@ -182,6 +183,7 @@ def _run(args) -> int:
     if args.halo_multiplier > 1:
         model.dd.set_halo_multiplier(args.halo_multiplier)
     _common.apply_exchange_route(args, model.dd)
+    _common.apply_numerics(args, model.dd)
     model.realize()
     if args.plan:
         print(f"wrote {model.dd.write_plan(args.prefix + 'plan')}", file=sys.stderr)

@@ -207,6 +207,12 @@ class DistributedDomain:
 
         self._divergence_every = env_int("STENCIL_DIVERGENCE_EVERY", 0, minimum=0)
         self._sentinel = None
+        # numerics observatory (telemetry/numerics.py): the fused on-device
+        # field-health engine, built lazily on first use; the observe
+        # cadence (snapshots + guardbands per STENCIL_NUMERICS_EVERY /
+        # --numerics-every) is independent of the sentinel's
+        self._numerics_every = env_int("STENCIL_NUMERICS_EVERY", 0, minimum=0)
+        self._numerics = None
         self._retry_policy = None
         # dispatch watchdog (resilience/watchdog.py): resolved lazily from
         # STENCIL_WATCHDOG_S at first dispatch, or installed programmatically
@@ -266,11 +272,42 @@ class DistributedDomain:
     def set_divergence_check(self, every: int) -> None:
         """Enable the divergence sentinel (resilience/sentinel.py): every
         ``every`` raw steps run through ``run_step``, each floating quantity
-        is checked for NaN/Inf and a classified ``DIVERGENCE`` error names
-        the quantity and step window.  0 disables (the default; the check
-        costs a host readback per quantity per cadence crossing)."""
+        is checked for NaN/Inf on-device (ONE fused numerics dispatch —
+        telemetry/numerics.py) and a classified ``DIVERGENCE`` error names
+        the quantity, the global first-non-finite coordinate, and the
+        bracketing step window.  0 disables (the default).  A mid-run
+        cadence change preserves the sentinel's accumulated step count, so
+        reported divergence steps stay correct."""
         self._divergence_every = int(every)
-        self._sentinel = None  # rebuild with the new cadence
+        if self._sentinel is not None:
+            self._sentinel.set_every(self._divergence_every)
+
+    def set_numerics_every(self, every: int) -> None:
+        """Enable the numerics observatory's snapshot cadence
+        (telemetry/numerics.py): every ``every`` raw steps through
+        ``run_step``, one fused on-device health snapshot (per-quantity
+        min/max/absmax/mean/L2/non-finite stats) lands in the engine's
+        ring and runs the registered guardbands.  0 disables (the
+        default; ``STENCIL_NUMERICS_EVERY`` / ``--numerics-every`` set it
+        from the run surface).  Like ``set_divergence_check``, a mid-run
+        change preserves the accumulated step count."""
+        self._numerics_every = int(every)
+        if self._numerics is not None:
+            self._numerics.set_every(self._numerics_every)
+
+    def numerics(self):
+        """This domain's :class:`~stencil_tpu.telemetry.numerics.
+        NumericsEngine` — the fused on-device field-statistics program
+        (built lazily, memoized per geometry signature, auto-rebuilt after
+        a mesh transition).  The divergence sentinel, the observe cadence,
+        and direct callers (tests, guardband registration) all share this
+        one engine, so they share one compiled program and one snapshot
+        ring."""
+        if self._numerics is None:
+            from stencil_tpu.telemetry.numerics import NumericsEngine
+
+            self._numerics = NumericsEngine(self, every=self._numerics_every)
+        return self._numerics
 
     # --- configuration (stencil.hpp:276-306) ---------------------------------
     def set_radius(self, radius) -> None:
@@ -710,6 +747,11 @@ class DistributedDomain:
         self._exchange_nbytes = None
         self._packed_nbytes = self._packed_nkernels = 0
         self._shell_stale = False
+        if self._numerics is not None:
+            # the stats program closes over the OLD mesh/spec; the engine's
+            # signature check would also catch this lazily, but a mesh
+            # transition is the one known invalidation point — be explicit
+            self._numerics.on_mesh_change()
         t1 = time.perf_counter()
         self._exchange_route = self._resolve_exchange_route()
         self._exchange_fn = self._build_exchange_with_ladder()
@@ -755,6 +797,8 @@ class DistributedDomain:
         self._exchange_nbytes = None
         self._packed_nbytes = self._packed_nkernels = 0
         self._shell_stale = False
+        if self._numerics is not None:
+            self._numerics.on_mesh_change()
         self._realized = False
         self.realize()
 
@@ -1655,9 +1699,23 @@ class DistributedDomain:
         # goes stale and raw readback must re-exchange first
         if getattr(step_fn, "_marks_shell_stale", False):
             self.mark_shell_stale()
-        if self._sentinel is None or self._sentinel.every != self._divergence_every:
+        if self._sentinel is None:
             self._sentinel = DivergenceSentinel(self._divergence_every)
+        elif self._sentinel.every != self._divergence_every:
+            # cadence changed mid-run (set_divergence_check on a domain
+            # whose sentinel predates the setter): update in place — a
+            # rebuild would silently reset steps_done and mislabel every
+            # later divergence step
+            self._sentinel.set_every(self._divergence_every)
         # sentinel cadence and the reported step index are in RAW iterations:
         # a macro step (halo multiplier on the xla engine) advances `mult`
         # raw iterations per dispatch-step, which the built step declares
         self._sentinel.after_steps(self, raw)
+        # the numerics observatory's independent observe cadence (snapshots
+        # + guardbands — telemetry/numerics.py).  ALWAYS accounted, even
+        # with the cadence off: the engine's step counter must agree with
+        # the sentinel's when the observatory is enabled mid-run (a
+        # counter that starts at the enable point would mislabel every
+        # snapshot and defeat the shared-dispatch dedupe), and off-cadence
+        # accounting is two int ops on a jax-free object
+        self.numerics().after_steps(raw)

@@ -143,6 +143,16 @@ class AstarothSim:
         w = 2 * math.pi / self.period
         for h in self.handles:
             self.dd.init_by_coords(h, lambda x, y, z: jnp.sin(w * (x + y + z)))
+        # shipped numerics guardband (docs/observability.md "Numerics
+        # observatory"): the mean-of-6 update is non-expansive, so every
+        # quantity's magnitude stays under its unit-amplitude sin init —
+        # a growing absmax means the numerics drifted.  Envelope at 1.5x
+        # the amplitude: far above any rounding, far below a real blow-up.
+        from stencil_tpu.telemetry.numerics import magnitude_envelope
+
+        self.dd.numerics().register_guardband(
+            magnitude_envelope(1.5, quantities=tuple(h.name for h in self.handles))
+        )
         if self.dd.halo_multiplier() != 1 and self.schedule == "per-step":
             # on EITHER kernel_impl a multiplier means fewer, wider
             # exchanges — the opposite of the cadence 'per-step' promises

@@ -140,6 +140,25 @@ class Jacobi3D:
         # set compute region to (HOT+COLD)/2 (jacobi3d.cu:15-29, 253-263)
         mid = (HOT_TEMP + COLD_TEMP) / 2
         self.dd.init_by_coords(self.h, lambda x, y, z: jnp.full((), mid) + 0 * (x + y + z))
+        # shipped numerics guardband (docs/observability.md "Numerics
+        # observatory"): jacobi's clamped mean-of-6 update obeys the
+        # diffusion max principle — the field can never leave [COLD, HOT];
+        # a cell outside the band is numerical drift long before anything
+        # overflows to inf.  Registration is idempotent (keyed by label);
+        # it fires only on the numerics cadence, so an unsnapshotted run
+        # pays nothing.
+        from stencil_tpu.telemetry.numerics import max_principle
+
+        # band widened by 1e-5 of the span: the f32-accumulated mean can
+        # legitimately overshoot the exact bound by a few ulps (six adds at
+        # magnitude ~6 before the divide) — the guardband hunts drift, not
+        # last-ulp rounding
+        pad = 1e-5 * (HOT_TEMP - COLD_TEMP)
+        self.dd.numerics().register_guardband(
+            max_principle(
+                COLD_TEMP - pad, HOT_TEMP + pad, quantities=(self.h.name,)
+            )
+        )
         if self.kernel_impl == "pallas":
             if self._wavefront_m:
                 self._step = self._make_wavefront_step()

@@ -1,40 +1,55 @@
-"""Divergence sentinel: optional NaN/Inf detection at a step cadence.
+"""Divergence sentinel: NaN/Inf detection at a step cadence, on-device.
 
 A diverged stencil run (unstable step size, corrupted halo, bad forcing)
 keeps consuming accelerator hours producing garbage — and NaN spreads one
 stencil radius per step, so by readback time the whole field is gone with no
-hint of WHEN it broke.  The sentinel trades a configurable amount of
-readback for the first non-finite value's step window and quantity name,
-raised as a classified ``DIVERGENCE`` error (never retried, never degraded:
-re-running the same numerics diverges again).
+hint of WHEN or WHERE it broke.  The sentinel answers all three: the check
+rides the on-device numerics engine (``telemetry/numerics.py`` — ONE fused
+sharded dispatch per check, O(#quantities) scalars to the host, never a
+per-quantity gather), so a trip raises a classified ``DIVERGENCE`` error
+naming the quantity, the **global coordinate of the first non-finite
+cell**, and the bracketing step window ``(last clean check, detection
+step]`` — the first-bad-step uncertainty interval (never retried, never
+degraded: re-running the same numerics diverges again).
 
-Off by default.  Enable with ``STENCIL_DIVERGENCE_EVERY=<n>`` (check every n
-raw steps) or programmatically via
+Off by default.  Enable with ``STENCIL_DIVERGENCE_EVERY=<n>`` (check every
+n raw steps) or programmatically via
 ``DistributedDomain.set_divergence_check(n)``; models expose a
-``check_divergence_every`` constructor knob.  The check reads each quantity
-back through ``quantity_to_host`` — which gathers INTERIOR cells only, so
-fast-path kernels' stale/uninitialized shell planes can never
-false-positive (shell bytes are simply never consulted) — and costs a full
-device->host gather per quantity per check: pick a cadence that amortizes
-it (hundreds of steps), or leave it off for benchmarking.
+``check_divergence_every`` constructor knob.  The stats program masks each
+shard's interior to its VALID cells, so fast-path kernels' stale or
+uninitialized shell planes (and pad-and-mask padding) can never
+false-positive — shell and pad bytes are simply never consulted.  The
+snapshot the check takes also lands in the engine's bounded ring, so a
+DIVERGENCE crash report carries the field-health history leading up to the
+trip.
 """
 
 from __future__ import annotations
-
-import numpy as np
 
 from stencil_tpu.resilience.taxonomy import DivergenceError
 
 
 class DivergenceSentinel:
-    """Tracks cumulative steps and checks all quantities for non-finite
-    values whenever the count crosses a multiple of ``every``."""
+    """Tracks cumulative raw steps and checks every floating quantity for
+    non-finite values (via the domain's numerics engine) whenever the
+    count crosses a multiple of ``every``."""
 
     def __init__(self, every: int):
         if every < 0:
             raise ValueError(f"divergence check cadence must be >= 0, got {every}")
         self.every = every
         self.steps_done = 0
+        #: the last step a check RAN clean at — the low edge of the next
+        #: trip's uncertainty window (0 until the first check)
+        self.last_checked = 0
+
+    def set_every(self, every: int) -> None:
+        """Change the cadence WITHOUT resetting the accumulated step count:
+        a mid-run ``set_divergence_check`` must keep reported divergence
+        steps correct."""
+        if every < 0:
+            raise ValueError(f"divergence check cadence must be >= 0, got {every}")
+        self.every = int(every)
 
     def after_steps(self, dd, steps: int) -> None:
         """Account ``steps`` just run on ``dd``; check on cadence crossings.
@@ -45,16 +60,28 @@ class DivergenceSentinel:
             return
         if before // self.every == self.steps_done // self.every:
             return
-        for h in dd._handles:
-            if not np.issubdtype(np.dtype(h.dtype), np.inexact):
-                continue  # integer fields cannot go non-finite
-            vals = dd.quantity_to_host(h)
-            if not np.isfinite(vals).all():
-                from stencil_tpu import telemetry
-                from stencil_tpu.telemetry import names as tm
+        window = (self.last_checked, self.steps_done)
+        snap = dd.numerics().snapshot(step=self.steps_done, window=window)
+        for st in snap.stats:
+            if not st.nonfinite:
+                continue
+            from stencil_tpu import telemetry
+            from stencil_tpu.telemetry import names as tm
 
-                telemetry.inc(tm.SENTINEL_TRIPS)
-                telemetry.emit_event(
-                    tm.EVENT_DIVERGENCE, quantity=h.name, step=self.steps_done
-                )
-                raise DivergenceError(quantity=h.name, step=self.steps_done)
+            telemetry.inc(tm.SENTINEL_TRIPS)
+            telemetry.emit_event(
+                tm.EVENT_DIVERGENCE,
+                quantity=st.name,
+                step=self.steps_done,
+                window=list(window),
+                coord=list(st.first_nonfinite)
+                if st.first_nonfinite is not None
+                else None,
+            )
+            raise DivergenceError(
+                quantity=st.name,
+                step=self.steps_done,
+                window=window,
+                coord=st.first_nonfinite,
+            )
+        self.last_checked = self.steps_done

@@ -46,10 +46,13 @@ preemption notice, a SIGKILL, a FATAL dispatch error, a wedged device.
   caller rebuild step functions closed over the old mesh.
 * **Flight recorder** — a rank-0 ``status.json`` heartbeat in the
   checkpoint dir per chunk (step, steady-state rate, checkpoint age,
-  watchdog state, restart count, last classified error) and a
+  watchdog state, restart count, last classified error, and the numerics
+  observatory's last per-quantity health snapshot) and a
   ``crash_report.json`` (classified cause + the last-N telemetry events
-  from the in-memory ring) on any propagating FATAL/STALL/PREEMPTED
-  exit; ``python -m stencil_tpu.status <dir>`` renders both
+  from the in-memory ring + the numerics snapshot ring — on a DIVERGENCE
+  exit, the field-health history leading up to the trip) on any
+  propagating FATAL/STALL/PREEMPTED/DIVERGENCE exit;
+  ``python -m stencil_tpu.status <dir>`` renders both
   (telemetry/flight.py, docs/observability.md "Flight recorder").
 
 Knobs (validated reads — utils/config.py): ``STENCIL_CHECKPOINT_DIR``,
@@ -263,6 +266,35 @@ class RunSupervisor:
         except Exception:  # noqa: BLE001 — a heartbeat must never raise
             return None
 
+    def _numerics_last(self) -> Optional[dict]:
+        """The numerics observatory's LAST snapshot (per-quantity health)
+        for the heartbeat, or None when the engine was never used — read
+        off the existing engine only (a heartbeat must not build programs
+        or dispatch anything)."""
+        eng = getattr(self.dd, "_numerics", None)
+        try:
+            return eng.last_as_json() if eng is not None else None
+        except Exception:  # noqa: BLE001 — a heartbeat must never raise
+            return None
+
+    def _numerics_ring(self) -> Optional[list]:
+        """The bounded snapshot ring for crash reports: on a DIVERGENCE
+        exit this is the field-health history leading up to the trip."""
+        eng = getattr(self.dd, "_numerics", None)
+        try:
+            ring = eng.ring_as_json() if eng is not None else None
+        except Exception:  # noqa: BLE001 — crash paths must never re-raise
+            return None
+        return ring or None
+
+    def _crash_report(self, cause: str, error: Optional[str] = None, **state) -> None:
+        """Every supervisor crash report carries the numerics ring — the
+        one artifact that says what the FIELDS looked like on the way
+        down, not just what the process did."""
+        self.flight.crash_report(
+            cause, error=error, numerics_ring=self._numerics_ring(), **state
+        )
+
     def _heartbeat(
         self, step: int, total_steps: int, restarts: int, last_ck: float,
         phase: str = "running",
@@ -284,6 +316,7 @@ class RunSupervisor:
             mesh_transitions=len(self.mesh_history),
             mesh_history=self.mesh_history[-8:],
             last_error=self._last_error,
+            numerics=self._numerics_last(),
             run_state=self._run_state() if self._run_state is not None else None,
         )
 
@@ -424,7 +457,7 @@ class RunSupervisor:
             # the same answer: the recorded restore fallback
             restored = self._charge_fallback(step, target, why=str(e))
             if restored is None:
-                self.flight.crash_report("capacity_loss", error=str(e))
+                self._crash_report("capacity_loss", error=str(e))
                 raise
             return restored
         self._record_transition(
@@ -610,7 +643,7 @@ class RunSupervisor:
                         # else the budget-charged checkpoint fallback
                         recovered = self._recover_capacity_loss(step, n, e)
                         if recovered is None:
-                            self.flight.crash_report(cls.value, error=str(e))
+                            self._crash_report(cls.value, error=str(e))
                             raise
                         step = recovered
                         last_ck = time.monotonic()
@@ -624,7 +657,7 @@ class RunSupervisor:
                         if self.resumed_path is None:
                             # nothing valid to restart from — the exit is
                             # final, so dump the post-mortem first
-                            self.flight.crash_report(cls.value, error=str(e))
+                            self._crash_report(cls.value, error=str(e))
                             raise
                         self._restarts += 1
                         self._credits_used += 1
@@ -651,7 +684,7 @@ class RunSupervisor:
                         # out of budget, no checkpoint to restart from, or a
                         # class the in-process machinery owns — propagate,
                         # leaving the crash report as the post-mortem
-                        self.flight.crash_report(cls.value, error=str(e))
+                        self._crash_report(cls.value, error=str(e))
                         raise
                 else:
                     step += n
@@ -700,7 +733,7 @@ class RunSupervisor:
                         step, total_steps, self._restarts, last_ck,
                         phase="preempted",
                     )
-                    self.flight.crash_report(
+                    self._crash_report(
                         "preempted",
                         error=self._preempt_why,
                         mid_chunk=mid_chunk,

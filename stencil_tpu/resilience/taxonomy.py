@@ -70,17 +70,39 @@ class ResilienceError(RuntimeError):
 
 
 class DivergenceError(ResilienceError):
-    """Raised by the divergence sentinel: a quantity went NaN/Inf."""
+    """Raised by the divergence sentinel (or an aborting numerics
+    guardband): a quantity went NaN/Inf or drifted past a registered
+    invariant.  Carries the quantity, the detection ``step``, the
+    bracketing step ``window`` — ``(last clean check, detection step]``,
+    the first-bad-step uncertainty interval — and, for non-finite trips,
+    the global 3D ``coord`` of the first non-finite cell (the on-device
+    numerics engine computes it inside the fused stats dispatch)."""
 
     failure_class = FailureClass.DIVERGENCE
 
-    def __init__(self, quantity: str, step: int):
+    def __init__(
+        self,
+        quantity: str,
+        step: int,
+        window: tuple = None,
+        coord: tuple = None,
+        why: str = None,
+    ):
         self.quantity = quantity
         self.step = step
-        super().__init__(
-            f"quantity {quantity!r} contains non-finite values at step {step} "
-            "(divergence sentinel)"
-        )
+        self.window = tuple(window) if window is not None else None
+        self.coord = tuple(coord) if coord is not None else None
+        self.why = why
+        what = why or "contains non-finite values"
+        msg = f"quantity {quantity!r} {what} at step {step}"
+        if self.coord is not None:
+            msg += f", first non-finite cell at global {self.coord}"
+        if self.window is not None:
+            msg += (
+                f"; diverged within step window ({self.window[0]}, "
+                f"{self.window[1]}]"
+            )
+        super().__init__(msg + " (divergence sentinel)")
 
 
 class PreemptionError(ResilienceError):

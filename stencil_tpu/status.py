@@ -35,6 +35,47 @@ def _age(ts) -> str:
     return f"{dt / 3600:.1f}h"
 
 
+def _fmt_stat(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.6g}"
+
+
+def _numerics_lines(doc, indent: str = "  ") -> list:
+    """Per-quantity health lines from one numerics snapshot document
+    (telemetry/numerics.py ``NumericsSnapshot.as_json``)."""
+    if not isinstance(doc, dict):
+        return []
+    lines = []
+    step = doc.get("step")
+    head = f"{indent}numerics"
+    if step is not None:
+        head += f" @ step {step}"
+    win = doc.get("window")
+    if isinstance(win, (list, tuple)) and len(win) == 2:
+        head += f" (window {win[0]}..{win[1]}]"
+    lines.append(head + ":")
+    for name, st in sorted((doc.get("quantities") or {}).items()):
+        if not isinstance(st, dict):
+            continue
+        row = (
+            f"{indent}  {name}: min {_fmt_stat(st.get('min'))}, "
+            f"max {_fmt_stat(st.get('max'))}, "
+            f"mean {_fmt_stat(st.get('mean'))}, "
+            f"l2 {_fmt_stat(st.get('l2'))}"
+        )
+        nbad = st.get("nonfinite") or 0
+        if nbad:
+            row += f", NON-FINITE x{nbad}"
+            coord = st.get("first_nonfinite")
+            if isinstance(coord, (list, tuple)):
+                row += f" (first at global {tuple(coord)})"
+        else:
+            row += ", finite"
+        lines.append(row)
+    return lines
+
+
 def render(status, crash, stale_after: float = 300.0) -> str:
     """The human view of one run directory's flight state."""
     lines = []
@@ -88,6 +129,9 @@ def render(status, crash, stale_after: float = 300.0) -> str:
                 f"  mesh {t.get('kind', '?')} at step {t.get('step')}: "
                 f"{frm} -> {to} in {t.get('seconds')}s ({t.get('source')})"
             )
+        # numerics observatory: the heartbeat's last per-quantity health
+        # snapshot (docs/observability.md "Numerics observatory")
+        lines.extend(_numerics_lines(status.get("numerics")))
         if status.get("last_error"):
             lines.append(f"  last error: {status['last_error']}")
     if crash is not None:
@@ -96,6 +140,13 @@ def render(status, crash, stale_after: float = 300.0) -> str:
         )
         if crash.get("error"):
             lines.append(f"  error: {crash['error']}")
+        # the numerics snapshot ring: on a DIVERGENCE exit this is the
+        # field-health history leading up to the trip — render the final
+        # snapshot in full, and say how much history the report carries
+        ring = crash.get("numerics_ring") or []
+        if ring:
+            lines.append(f"  numerics ring: {len(ring)} snapshot(s); last:")
+            lines.extend(_numerics_lines(ring[-1], indent="    "))
         events = crash.get("events") or []
         if events:
             lines.append(f"  last {len(events)} events:")

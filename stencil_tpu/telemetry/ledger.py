@@ -129,6 +129,22 @@ def entries_from_artifact(path: str) -> List[dict]:
                     k=mxu_ab.get("k"),
                 )
             )
+        # the numerics observatory's on/off A/B (bench.py
+        # numerics_overhead): per-snapshot cost of the fused on-device
+        # field-health dispatch — LOWER-is-better (the gate flags a rise),
+        # so the "cheap enough to leave on" claim is enforced per round
+        num_ab = bench.get("numerics_overhead") or {}
+        out.append(
+            _entry(
+                ts,
+                "numerics:overhead",
+                num_ab.get("snapshot_ms"),
+                "ms",
+                source,
+                better="lower",
+                quantities=num_ab.get("quantities"),
+            )
+        )
         return [e for e in out if e is not None]
 
     if isinstance(doc, dict) and doc.get("bench") == "weak_scaling_sweep":

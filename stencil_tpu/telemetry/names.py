@@ -102,6 +102,15 @@ RESHARD_BYTES = "reshard.bytes"
 #: checkpoint-elastic-restore (devices gone, no admissible partition,
 #: consumed buffers) — each one also charges the supervisor restart budget
 RESHARD_FALLBACKS = "reshard.fallbacks"
+#: fused on-device field-health snapshots taken (telemetry/numerics.py
+#: ``NumericsEngine.snapshot`` — one sharded dispatch, O(#quantities)
+#: scalars to the host; the cadence paths STENCIL_NUMERICS_EVERY and the
+#: rewired divergence sentinel both count here)
+NUMERICS_SNAPSHOTS = "numerics.snapshots"
+#: guardband violations observed over those snapshots (the invariant
+#: drifted but stayed finite — observe-only unless STENCIL_NUMERICS_ABORT
+#: escalates).  Doubles as the event name: one constant, one series.
+NUMERICS_DRIFT = "numerics.drift"
 
 ALL_COUNTERS = frozenset({
     EXCHANGE_COUNT,
@@ -133,6 +142,8 @@ ALL_COUNTERS = frozenset({
     RESHARDS,
     RESHARD_BYTES,
     RESHARD_FALLBACKS,
+    NUMERICS_SNAPSHOTS,
+    NUMERICS_DRIFT,
 })
 
 # --- gauges (last-value) -----------------------------------------------------
@@ -166,6 +177,10 @@ CHECKPOINT_RESTORE_SECONDS = "checkpoint.restore.seconds"
 #: wall seconds per in-memory mesh transition (plan + collective schedule
 #: + exchange re-realize + tuner re-key — ``DistributedDomain.reshard``)
 RESHARD_SECONDS = "reshard.seconds"
+#: wall seconds per fused numerics snapshot (dispatch + the scalar
+#: readback — the "cheap enough to leave on" figure bench.py's
+#: numerics_overhead A/B regression-gates)
+NUMERICS_SNAPSHOT_SECONDS = "numerics.snapshot.seconds"
 
 ALL_HISTOGRAMS = frozenset({
     STEP_SECONDS,
@@ -176,6 +191,7 @@ ALL_HISTOGRAMS = frozenset({
     CHECKPOINT_SAVE_SECONDS,
     CHECKPOINT_RESTORE_SECONDS,
     RESHARD_SECONDS,
+    NUMERICS_SNAPSHOT_SECONDS,
 })
 
 # --- spans (Chrome-trace timeline entries) -----------------------------------
@@ -219,7 +235,9 @@ EVENT_RETRY_REFUSED = "resilience.retry_refused"
 EVENT_DESCENT = "resilience.descent"
 #: a STENCIL_FAULT_PLAN fault fired (fields: phase, label, failure_class)
 EVENT_FAULT = "resilience.fault_injected"
-#: the divergence sentinel tripped (fields: quantity, step)
+#: the divergence sentinel tripped (fields: quantity, step, window =
+#: [last clean check, detection step], coord = global first-non-finite
+#: cell or null — telemetry/numerics.py feeds all three on-device)
 EVENT_DIVERGENCE = "resilience.divergence"
 #: a tuning decision (fields: key, source=cache|search|static, config,
 #: trials, pruned)
@@ -305,6 +323,7 @@ ALL_EVENTS = frozenset({
     EVENT_RESHARD,
     EVENT_RESHARD_FALLBACK,
     EVENT_SUPERVISOR_REPLENISH,
+    NUMERICS_DRIFT,
 })
 
 #: every registered name, any kind — what the lint checks literals against

@@ -1,0 +1,427 @@
+"""Tier-1: the on-device numerics observatory (telemetry/numerics.py).
+
+The ISSUE-15 pins: the fused stats program against a numpy interior
+reference across dtypes / storage / uneven shards / halo-multiplier shells
+/ multi-component quantities (exact for the order-independent stats, tight
+tolerance for the accumulated moments), the first-non-finite global
+coordinate, the rewired divergence sentinel's zero-host-gather spy, the
+step-window reporting, guardband observe/abort paths, the snapshot ring,
+and the end-to-end DIVERGENCE crash-report / status story.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu import telemetry
+from stencil_tpu.core.radius import Radius
+from stencil_tpu.domain import DistributedDomain
+from stencil_tpu.models.jacobi import Jacobi3D
+from stencil_tpu.resilience.taxonomy import DivergenceError, FailureClass, classify
+from stencil_tpu.telemetry import names as tm
+from stencil_tpu.telemetry.numerics import (
+    NumericsEngine,
+    SCALARS_PER_QUANTITY,
+    magnitude_envelope,
+    max_principle,
+)
+
+
+def _counter(name: str) -> int:
+    return telemetry.snapshot()["counters"][name]
+
+
+def _make_domain(size=(16, 16, 16), dtype=jnp.float32, storage=None,
+                 halo_mult=1, components=(), n_devices=8, with_int=True):
+    dd = DistributedDomain(*size)
+    dd.set_radius(Radius.constant(1))
+    dd.set_devices(jax.devices()[:n_devices])
+    if halo_mult > 1:
+        dd.set_halo_multiplier(halo_mult)
+    if storage is not None:
+        dd.set_storage(storage)
+    h = dd.add_data("q", dtype=dtype, components=components)
+    hi = dd.add_data("i", dtype=jnp.int32) if with_int else None
+    dd.realize()
+    return dd, h, hi
+
+
+def _fill(dd, h, seed=0):
+    rng = np.random.RandomState(seed)
+    shape = h.components + tuple(dd.size())
+    a = (rng.randn(*shape) * 3.0).astype(np.dtype(h.dtype))
+    dd.set_quantity(h, a)
+    # the reference view is what the domain actually STORES (bf16 storage
+    # rounds at set_quantity; quantity_to_host upcasts exactly)
+    return dd.quantity_to_host(h)
+
+
+# --- the stats matrix vs the numpy interior reference ------------------------
+
+
+CASES = {
+    "f32": {},
+    "f64": {"dtype": jnp.float64},
+    "bf16_storage": {"storage": "bf16"},
+    "uneven": {"size": (17, 17, 17)},
+    "halo_mult2": {"halo_mult": 2},
+    "components": {"components": (3,)},
+    "uneven_halo_mult2": {"size": (17, 17, 17), "halo_mult": 2},
+}
+
+
+@pytest.mark.parametrize("case", sorted(CASES), ids=sorted(CASES))
+def test_stats_matrix_vs_numpy_reference(case):
+    """Every stat the fused program ships, against numpy over the exact
+    stored interior: order-independent stats (min/max/absmax, the counts)
+    pin EXACTLY; the >=f32-accumulated moments (mean/L2) pin to the
+    accumulation dtype's tolerance (the reduction tree's order differs
+    from numpy's, bitwise equality is not defined for them)."""
+    dd, h, hi = _make_domain(**CASES[case])
+    ref = _fill(dd, h)
+    snap = dd.numerics().snapshot(step=7, window=(0, 7))
+
+    # the int quantity is skipped (cannot go non-finite; no float stats)
+    assert [s.name for s in snap.stats] == ["q"]
+    st = snap.stat("q")
+    assert st.dtype == np.dtype(h.dtype).name
+    # exact pins (upcasts are exact, min/max/absmax are order-free)
+    assert st.min == ref.min()
+    assert st.max == ref.max()
+    assert st.absmax == np.abs(ref).max()
+    assert st.finite == ref.size
+    assert st.nonfinite == 0
+    assert st.first_nonfinite is None
+    # accumulated moments: >= f32 accumulation per the PR-7 contract
+    rtol = 1e-12 if np.dtype(h.dtype) == np.float64 else 1e-5
+    assert st.mean == pytest.approx(ref.mean(), rel=rtol, abs=1e-7)
+    assert st.l2 == pytest.approx(
+        np.sqrt((ref.astype(np.float64) ** 2).sum()), rel=rtol
+    )
+    assert snap.step == 7 and snap.window == (0, 7)
+
+
+def test_first_nonfinite_is_global_row_major_first():
+    """Two poisoned cells on DIFFERENT shards: the reported coordinate is
+    the row-major-first one in GLOBAL coordinates, found without any
+    gather (the per-shard winners reduce as linear indices)."""
+    dd, h, _ = _make_domain(size=(17, 17, 17))
+    ref = _fill(dd, h)
+    bad = ref.copy()
+    bad[12, 3, 14] = np.inf   # a later cell, on another shard
+    bad[4, 15, 2] = np.nan    # the row-major first
+    dd.set_quantity(h, bad)
+    st = dd.numerics().snapshot().stat("q")
+    assert st.nonfinite == 2
+    assert st.first_nonfinite == (4, 15, 2)
+    # moment stats stay informative: computed over the FINITE cells only
+    finite = bad[np.isfinite(bad)]
+    assert st.finite == finite.size
+    assert st.min == finite.min() and st.max == finite.max()
+
+
+def test_all_nonfinite_field_reports_none_moments():
+    dd, h, _ = _make_domain(with_int=False)
+    dd.set_quantity(h, np.full(tuple(dd.size()), np.nan, np.float32))
+    st = dd.numerics().snapshot().stat("q")
+    assert st.nonfinite == 16 ** 3 and st.finite == 0
+    assert st.min is None and st.max is None and st.mean is None
+    assert st.first_nonfinite == (0, 0, 0)
+
+
+def test_program_memoized_and_rebuilt_on_mesh_change():
+    dd, h, _ = _make_domain()
+    _fill(dd, h)
+    eng = dd.numerics()
+    fn1, _, _ = eng.program()
+    fn2, _, _ = eng.program()
+    assert fn1 is fn2  # memoized: one trace per geometry
+    before = eng.snapshot().stat("q")
+    dd.reshard(devices=jax.devices()[:4])
+    fn3, _, _ = eng.program()
+    assert fn3 is not fn1  # the mesh transition rebuilt the program
+    after = eng.snapshot().stat("q")
+    # the redistributed field carries identical values: exact stats match
+    assert (after.min, after.max, after.absmax, after.finite) == (
+        before.min, before.max, before.absmax, before.finite
+    )
+
+
+def test_snapshot_ring_is_bounded_and_counted():
+    from stencil_tpu.telemetry.numerics import RING_SIZE
+
+    dd, h, _ = _make_domain(size=(16, 16, 16), n_devices=1, with_int=False)
+    _fill(dd, h)
+    eng = dd.numerics()
+    c0 = _counter(tm.NUMERICS_SNAPSHOTS)
+    for i in range(RING_SIZE + 5):
+        eng.snapshot(step=i)
+    assert len(eng.ring) == RING_SIZE
+    assert eng.last.step == RING_SIZE + 4
+    assert _counter(tm.NUMERICS_SNAPSHOTS) - c0 == RING_SIZE + 5
+    assert eng.last_as_json()["quantities"]["q"]["nonfinite"] == 0
+
+
+# --- the rewired sentinel -----------------------------------------------------
+
+
+def test_sentinel_performs_zero_host_gathers(monkeypatch):
+    """ISSUE-15 acceptance: the rewired sentinel path never calls
+    ``quantity_to_host`` — the check is ONE fused device dispatch with a
+    scalar readback, spy-pinned here."""
+    m = Jacobi3D(16, 16, 16, devices=jax.devices()[:1],
+                 check_divergence_every=1)
+    m.realize()
+    gathers = []
+    orig = m.dd.quantity_to_host
+    monkeypatch.setattr(
+        m.dd, "quantity_to_host",
+        lambda *a, **k: (gathers.append(a), orig(*a, **k))[1],
+    )
+    m.step(1)  # clean check on the cadence
+    arr = m.dd._curr["temp"]
+    c = tuple(s // 2 for s in arr.shape)  # an INTERIOR cell (single device)
+    m.dd._curr["temp"] = arr.at[c].set(jnp.nan)
+    with pytest.raises(DivergenceError) as ei:
+        m.step(1)
+    assert gathers == [], "sentinel gathered a quantity to the host"
+    assert ei.value.quantity == "temp"
+    assert ei.value.window == (1, 2)
+    assert ei.value.coord is not None
+
+
+def test_divergence_error_carries_exact_coordinate():
+    """Poison ONE interior cell; after one mean-of-6 step the first bad
+    cell in row-major order is the poisoned cell's -x neighbor — the
+    DIVERGENCE error names exactly it, in global coordinates."""
+    m = Jacobi3D(16, 16, 16, devices=jax.devices()[:8],
+                 check_divergence_every=1)
+    m.realize()
+    ref = m.dd.quantity_to_host(m.h)
+    bad = ref.copy()
+    bad[4, 5, 6] = np.nan  # outside both forcing spheres
+    m.dd.set_quantity(m.h, bad)
+    with pytest.raises(DivergenceError) as ei:
+        m.step(1)
+    assert ei.value.step == 1
+    assert ei.value.window == (0, 1)
+    # NaN spreads one radius per step; (3,5,6) is first in row-major order
+    assert ei.value.coord == (3, 5, 6)
+    assert classify(ei.value) is FailureClass.DIVERGENCE
+    # the event twin carries the same fields (always-live flight ring)
+    ev = [e for e in telemetry.recent_events() if e["event"] == tm.EVENT_DIVERGENCE][-1]
+    assert ev["quantity"] == "temp"
+    assert ev["window"] == [0, 1] and ev["coord"] == [3, 5, 6]
+
+
+def test_run_step_numerics_cadence_and_sentinel_share_snapshots():
+    """The observe cadence (set_numerics_every) snapshots through
+    ``run_step``; when the sentinel checks the same step, ONE fused
+    dispatch serves both (the ring dedupes by step)."""
+    m = Jacobi3D(16, 16, 16, devices=jax.devices()[:1])
+    m.realize()
+    m.dd.set_numerics_every(2)
+    m.dd.set_divergence_check(2)
+    c0 = _counter(tm.NUMERICS_SNAPSHOTS)
+    for _ in range(4):
+        m.step(1)
+    # crossings at steps 2 and 4; sentinel + observe share one each
+    assert _counter(tm.NUMERICS_SNAPSHOTS) - c0 == 2
+    eng = m.dd.numerics()
+    assert [s.step for s in eng.ring] == [2, 4]
+    assert eng.steps_done == 4
+
+
+def test_mid_run_enable_keeps_true_step_labels():
+    """Enabling the observatory mid-run (set_numerics_every on a domain
+    that never built the engine) must label snapshots with the RUN's step
+    count, not steps-since-enable: run_step accounts numerics steps
+    unconditionally, so the lazily-built engine is always in sync with
+    the sentinel's counter."""
+    m = Jacobi3D(16, 16, 16, devices=jax.devices()[:1])
+    m.realize()
+    m.dd._numerics = None  # as if no guardband registration built it
+    for _ in range(3):
+        m.step(1)
+    m.dd.set_numerics_every(2)
+    m.step(1)  # raw step 4 crosses the cadence
+    eng = m.dd.numerics()
+    assert eng.steps_done == 4
+    assert [s.step for s in eng.ring] == [4]
+
+
+def test_set_numerics_every_preserves_steps_done():
+    dd, h, _ = _make_domain(n_devices=1, with_int=False)
+    _fill(dd, h)
+    eng = dd.numerics()
+    eng.after_steps(3)
+    assert eng.steps_done == 3
+    dd.set_numerics_every(2)
+    assert eng.steps_done == 3  # cadence change never resets the count
+    assert eng.every == 2
+
+
+# --- guardbands ---------------------------------------------------------------
+
+
+def test_guardband_observe_mode_emits_drift_and_continues():
+    m = Jacobi3D(16, 16, 16, devices=jax.devices()[:1])
+    m.realize()  # registers the max-principle band [COLD, HOT]
+    ref = m.dd.quantity_to_host(m.h)
+    bad = ref.copy()
+    bad[2, 2, 2] = 7.5  # finite, but far outside the principle band
+    m.dd.set_quantity(m.h, bad)
+    c0 = _counter(tm.NUMERICS_DRIFT)
+    snap = m.dd.numerics().snapshot(step=3, window=(0, 3))  # observe-only
+    assert snap.stat("temp").max == pytest.approx(7.5)
+    assert _counter(tm.NUMERICS_DRIFT) - c0 == 1
+    ev = [e for e in telemetry.recent_events() if e["event"] == tm.NUMERICS_DRIFT][-1]
+    assert ev["quantity"] == "temp"
+    assert "max-principle" in ev["guardband"]
+    assert ev["abort"] is False and ev["step"] == 3
+
+
+def test_guardband_abort_mode_escalates_to_divergence(monkeypatch):
+    monkeypatch.setenv("STENCIL_NUMERICS_ABORT", "1")
+    m = Jacobi3D(16, 16, 16, devices=jax.devices()[:1])
+    m.realize()
+    ref = m.dd.quantity_to_host(m.h)
+    bad = ref.copy()
+    bad[2, 2, 2] = -9.0
+    m.dd.set_quantity(m.h, bad)
+    with pytest.raises(DivergenceError) as ei:
+        m.dd.numerics().snapshot(step=5, window=(4, 5))
+    assert classify(ei.value) is FailureClass.DIVERGENCE
+    assert ei.value.quantity == "temp"
+    assert ei.value.window == (4, 5)
+    assert "max-principle" in str(ei.value)
+
+
+def test_guardband_clean_field_stays_quiet():
+    m = Jacobi3D(16, 16, 16, devices=jax.devices()[:1])
+    m.realize()
+    c0 = _counter(tm.NUMERICS_DRIFT)
+    m.dd.set_numerics_every(1)
+    m.step(2)  # jacobi within [COLD, HOT] by the max principle
+    assert _counter(tm.NUMERICS_DRIFT) - c0 == 0
+
+
+def test_guardband_registration_is_idempotent_by_label():
+    dd, h, _ = _make_domain(n_devices=1, with_int=False)
+    eng = dd.numerics()
+    eng.register_guardband(magnitude_envelope(2.0, quantities=("q",)))
+    eng.register_guardband(magnitude_envelope(2.0, quantities=("q",)))
+    assert len([g for g in eng.guardbands() if "magnitude" in g.label]) == 1
+
+
+def test_shipped_guardband_factories():
+    from stencil_tpu.telemetry.numerics import FieldStats
+
+    st = FieldStats(name="u", dtype="float32", min=-0.5, max=1.5, absmax=1.5,
+                    mean=0.2, l2=1.0, finite=10, nonfinite=0,
+                    first_nonfinite=None)
+    assert max_principle(0.0, 1.0).check(st) is not None
+    assert max_principle(-1.0, 2.0).check(st) is None
+    assert magnitude_envelope(1.0).check(st) is not None
+    assert magnitude_envelope(2.0).check(st) is None
+    band = magnitude_envelope(1.0, quantities=("v",))
+    assert band.applies_to("v") and not band.applies_to("u")
+
+
+# --- end-to-end: crash report + status ---------------------------------------
+
+
+def test_divergence_crash_report_embeds_numerics_ring(tmp_path):
+    """The acceptance pin: a DIVERGENCE failure names quantity, global
+    coordinate, and step window END-TO-END — through the supervisor's
+    crash report and the ``python -m stencil_tpu.status`` renderer."""
+    from stencil_tpu.resilience.supervisor import RunSupervisor, SupervisorConfig
+    from stencil_tpu.status import render
+    from stencil_tpu.telemetry.flight import read_crash_report, read_status
+
+    m = Jacobi3D(16, 16, 16, devices=jax.devices()[:8],
+                 check_divergence_every=1)
+    m.realize()
+    ref = m.dd.quantity_to_host(m.h)
+    bad = ref.copy()
+    bad[4, 5, 6] = np.inf
+    m.dd.set_quantity(m.h, bad)
+    sup = RunSupervisor(
+        m.dd,
+        SupervisorConfig(dir=str(tmp_path), max_restarts=0),
+        label="numerics-e2e",
+    )
+    with pytest.raises(DivergenceError):
+        sup.run(4, lambda n: [m.step(1) for _ in range(n)], start_step=0)
+    crash = read_crash_report(str(tmp_path))
+    assert crash is not None and crash["cause"] == "divergence"
+    ring = crash["numerics_ring"]
+    assert ring, "DIVERGENCE crash report carries no numerics ring"
+    last = ring[-1]["quantities"]["temp"]
+    assert last["nonfinite"] > 0
+    assert last["first_nonfinite"] == [3, 5, 6]
+    assert ring[-1]["window"] == [0, 1]
+    # the human renderer names all three
+    text = render(read_status(str(tmp_path)), crash)
+    assert "NON-FINITE" in text
+    assert "(3, 5, 6)" in text
+    assert "divergence" in text
+
+
+def test_supervised_heartbeat_carries_last_snapshot(tmp_path):
+    from stencil_tpu.resilience.supervisor import RunSupervisor, SupervisorConfig
+    from stencil_tpu.status import render
+    from stencil_tpu.telemetry.flight import read_status
+
+    m = Jacobi3D(16, 16, 16, devices=jax.devices()[:1])
+    m.realize()
+    m.dd.set_numerics_every(1)
+    sup = RunSupervisor(
+        m.dd, SupervisorConfig(dir=str(tmp_path)), label="numerics-hb"
+    )
+    out = sup.run(2, lambda n: [m.step(1) for _ in range(n)], start_step=0)
+    assert out.completed
+    status = read_status(str(tmp_path))
+    num = status["numerics"]
+    assert num["step"] == 2
+    q = num["quantities"]["temp"]
+    assert q["nonfinite"] == 0 and q["min"] is not None
+    text = render(status, None)
+    assert "numerics @ step 2" in text and "finite" in text
+
+
+def test_status_renders_synthetic_numerics_doc():
+    from stencil_tpu.status import render
+
+    status = {
+        "label": "r", "phase": "running", "step": 9, "total_steps": 20,
+        "ts": 0, "pid": 1,
+        "numerics": {
+            "step": 9, "window": [6, 9],
+            "quantities": {
+                "rho": {"min": 0.1, "max": 2.0, "mean": 1.0, "l2": 50.0,
+                        "nonfinite": 0},
+                "uu": {"min": None, "max": None, "mean": None, "l2": None,
+                       "nonfinite": 12, "first_nonfinite": [1, 2, 3]},
+            },
+        },
+    }
+    text = render(status, None, stale_after=1e9)
+    assert "numerics @ step 9" in text
+    assert "rho: min 0.1" in text
+    assert "NON-FINITE x12" in text and "(1, 2, 3)" in text
+
+
+# --- program shape (the local half of the numerics-bounded story) ------------
+
+
+def test_program_output_is_scalars_only():
+    dd, h, _ = _make_domain(size=(17, 17, 17), halo_mult=2)
+    _fill(dd, h)
+    fn, args, names = dd.numerics().program()
+    closed = jax.make_jaxpr(fn)(*args)
+    assert names == ["q"]
+    outs = closed.jaxpr.outvars
+    assert len(outs) <= SCALARS_PER_QUANTITY * len(names)
+    assert all(tuple(v.aval.shape) == () for v in outs)

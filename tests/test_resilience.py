@@ -596,32 +596,99 @@ class TestDivergenceSentinel:
             m.step(1)
         assert ei.value.quantity == "temp"
         assert ei.value.step == 2
+        # the on-device path adds the uncertainty window (the step-1 check
+        # ran clean) and a global first-non-finite coordinate
+        assert ei.value.window == (1, 2)
+        assert ei.value.coord is not None
+        assert all(0 <= c < 16 for c in ei.value.coord)
         assert classify(ei.value) is FailureClass.DIVERGENCE
 
     def test_cadence_skips_intermediate_checks(self):
-        import types
-
         from stencil_tpu.resilience.sentinel import DivergenceSentinel
+        from stencil_tpu.telemetry.numerics import FieldStats, NumericsSnapshot
+
+        poisoned = [True]
+        calls = []
+
+        class FakeEngine:
+            def snapshot(self, step=None, window=None):
+                calls.append((step, window))
+                bad = poisoned[0]
+                st = FieldStats(
+                    name="u", dtype="float32", min=0.0, max=1.0, absmax=1.0,
+                    mean=0.5, l2=1.0, finite=7,
+                    nonfinite=1 if bad else 0,
+                    first_nonfinite=(1, 2, 3) if bad else None,
+                )
+                return NumericsSnapshot(
+                    step=step, window=window, ts=0.0, seconds=0.0, stats=(st,)
+                )
 
         class FakeDD:
-            _handles = [types.SimpleNamespace(name="u", dtype=np.float32)]
-
-            def quantity_to_host(self, h):
-                return np.array([np.nan])  # poisoned from the start
+            def numerics(self):
+                return FakeEngine()
 
         s = DivergenceSentinel(10)
         s.after_steps(FakeDD(), 4)  # 4: no crossing, no check, no raise
         s.after_steps(FakeDD(), 5)  # 9: still below the cadence
         assert s.steps_done == 9
+        assert calls == []  # no crossing -> no fused dispatch at all
         with pytest.raises(DivergenceError) as ei:
             s.after_steps(FakeDD(), 5)  # 14 crosses 10: checked
         assert ei.value.quantity == "u" and ei.value.step == 14
-        # integer quantities are never checked (cannot go non-finite)
-        class IntDD(FakeDD):
-            _handles = [types.SimpleNamespace(name="i", dtype=np.int32)]
+        # the error carries the bracketing step window (no check had run
+        # clean yet, so the low edge is 0) and the on-device coordinate
+        assert ei.value.window == (0, 14)
+        assert ei.value.coord == (1, 2, 3)
+        assert calls == [(14, (0, 14))]
 
-        s2 = DivergenceSentinel(1)
-        s2.after_steps(IntDD(), 1)
+    def test_window_low_edge_is_last_clean_check(self):
+        """A clean crossing advances the window's low edge: the next trip
+        brackets the first bad step to (last clean check, detection]."""
+        from stencil_tpu.resilience.sentinel import DivergenceSentinel
+        from stencil_tpu.telemetry.numerics import FieldStats, NumericsSnapshot
+
+        poisoned = [False]
+
+        class FakeEngine:
+            def snapshot(self, step=None, window=None):
+                bad = poisoned[0]
+                st = FieldStats(
+                    name="u", dtype="float32", min=0.0, max=1.0, absmax=1.0,
+                    mean=0.5, l2=1.0, finite=7,
+                    nonfinite=1 if bad else 0,
+                    first_nonfinite=(0, 0, 0) if bad else None,
+                )
+                return NumericsSnapshot(
+                    step=step, window=window, ts=0.0, seconds=0.0, stats=(st,)
+                )
+
+        class FakeDD:
+            def numerics(self):
+                return FakeEngine()
+
+        s = DivergenceSentinel(5)
+        s.after_steps(FakeDD(), 6)  # 6 crosses 5: clean check
+        assert s.last_checked == 6
+        poisoned[0] = True
+        with pytest.raises(DivergenceError) as ei:
+            s.after_steps(FakeDD(), 6)  # 12 crosses 10: trips
+        assert ei.value.window == (6, 12)
+
+    def test_set_every_preserves_steps_done(self):
+        """ISSUE-15 satellite: changing the cadence mid-run (the domain's
+        set_divergence_check) must not reset the accumulated step count —
+        reported divergence steps would otherwise restart from zero."""
+        m = Jacobi3D(16, 16, 16, devices=jax.devices()[:1])
+        m.realize()
+        m.dd.set_divergence_check(7)
+        m.step(2)
+        assert m.dd._sentinel.steps_done == 2
+        m.dd.set_divergence_check(3)  # mid-run cadence change
+        assert m.dd._sentinel.steps_done == 2  # preserved, not rebuilt
+        assert m.dd._sentinel.every == 3
+        m.step(2)
+        assert m.dd._sentinel.steps_done == 4
 
     def test_macro_steps_count_as_raw_iterations(self):
         """Under a halo multiplier the xla engine's built step is a MACRO

@@ -8,8 +8,11 @@ Thin shim over ``stencil_tpu/telemetry/ledger.py`` (jax-free):
     # own exchange_ab:* series, so packed-route wins are regression-gated
     # like the headline numbers; soak_summary.json artifacts land as the
     # LOWER-is-better `reshard:seconds` / `soak:recovery_seconds` series
-    # (the gate flags rises there, not drops)
-    python scripts/perf_ledger.py ingest BENCH_*.json weak_scaling_out/weak_scaling_summary.json exchange_ab.json soak_out/soak_summary.json
+    # (the gate flags rises there, not drops); serve_summary.json serving
+    # artifacts (bin/stencil_serve.py, run_soak.py --serve) land as the
+    # LOWER-is-better `serve:p99_ms` / `serve:shed_rate` SLO series, and
+    # only when their tenant-isolation verdict held
+    python scripts/perf_ledger.py ingest BENCH_*.json weak_scaling_out/weak_scaling_summary.json exchange_ab.json soak_out/soak_summary.json serve_out/serve_summary.json
 
     # the regression gate: newest value per series vs its trailing median
     python scripts/perf_ledger.py check --threshold 0.1 --window 5

@@ -29,17 +29,40 @@ pins bitwise continuity ACROSS mesh transitions as well as kills, and
 seconds (``scripts/perf_ledger.py`` ingests them as the regression-gated
 ``reshard:seconds`` / ``soak:recovery_seconds`` series).
 
+``--serve`` runs the SERVING-LAYER chaos story instead (docs/serving.md):
+reference-vs-chaos pairs of the multi-tenant serving driver
+(``stencil_tpu.bin.stencil_serve``, >= 3 tenants) prove the per-tenant
+fault-isolation contract —
+
+* a ``poison_request`` seeded against one tenant evicts ONLY that tenant:
+  every other tenant's final-field digest is bitwise identical to the
+  fault-free reference;
+* a ``vmem_oom`` seeded against one tenant is answered inside that
+  tenant's envelope (ladder descent or quarantine), healthy tenants again
+  bitwise identical;
+* injected ``overload`` sheds requests WITHOUT evicting any healthy
+  tenant (every envelope stays active);
+* the elastic leg (load-driven grow/shrink through
+  ``DistributedDomain.reshard``) stays bitwise identical to its
+  fixed-mesh twin and decides exactly one grow + one shrink.
+
+The verdict lands in ``serve_summary.json`` (``bench: "serve_soak"``,
+``isolation_ok``) — ``scripts/perf_ledger.py`` ingests the reference
+leg's p99/shed-rate only when the isolation verdict holds.
+
 ``--dryrun`` forces the CPU backend with one fake device (two under
-``--reshard`` — a mesh must have somewhere to shrink from) so the whole
-chaos story runs on any machine; without it the driver uses the host's
-real devices.  A ``soak_summary.json`` artifact records every kill,
-resume, transition, and the final verdict.
+``--reshard``, four under ``--serve`` — a mesh must have somewhere to
+shrink from) so the whole chaos story runs on any machine; without it
+the driver uses the host's real devices.  A ``soak_summary.json``
+artifact records every kill, resume, transition, and the final verdict.
 
     python scripts/run_soak.py --dryrun
     python scripts/run_soak.py --dryrun --reshard
+    python scripts/run_soak.py --dryrun --serve
 
-The in-process tier-1 twin of this harness (one kill point, no
-subprocesses) is ``tests/test_supervisor.py``.
+The in-process tier-1 twins of this harness (one kill point / fake-clock
+servers, no subprocesses) are ``tests/test_supervisor.py`` and
+``tests/test_serve.py``.
 """
 
 from __future__ import annotations
@@ -54,7 +77,8 @@ import sys
 
 # runnable as `python scripts/run_soak.py` from anywhere: the manifest
 # readers import stencil_tpu (jax-free modules only) from the repo root
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
 
 #: the supervisor's resumable exit (sysexits EX_TEMPFAIL)
 EXIT_RESUMABLE = 75
@@ -97,6 +121,18 @@ def build_parser() -> argparse.ArgumentParser:
         "hooks -> in-memory drain-and-reshard) into the chaos run, "
         "interleaved with the kills; bitwise continuity must hold across "
         "mesh transitions too",
+    )
+    p.add_argument(
+        "--serve",
+        action="store_true",
+        help="run the SERVING-LAYER chaos story instead: reference-vs-"
+        "chaos pairs of the multi-tenant serving driver proving tenant "
+        "fault isolation, overload shedding, and the elasticity bitwise "
+        "A/B (see module docstring)",
+    )
+    p.add_argument(
+        "--serve-cycles", type=int, default=20,
+        help="load-generator cycles per serve leg",
     )
     return p
 
@@ -189,8 +225,158 @@ def harvest_transitions(ckpt_dir: str) -> list:
     return list(status.get("mesh_history") or [])
 
 
+# --- the serving-layer chaos story (--serve) -------------------------------
+
+
+def serve_leg(args, name: str, extra: list, fault_plan: str = "") -> dict:
+    """One stencil_serve subprocess run; returns its serve_summary.json."""
+    out = os.path.join(args.out_dir, name)
+    shutil.rmtree(out, ignore_errors=True)
+    cmd = [
+        sys.executable, "-m", "stencil_tpu.bin.stencil_serve",
+        "--tenants", "3", "--size", "8",
+        "--cycles", str(args.serve_cycles), "--peak", "4",
+        "--out", out, *extra,
+    ]
+    env = dict(os.environ)
+    env.pop("STENCIL_FAULT_PLAN", None)
+    if fault_plan:
+        env["STENCIL_FAULT_PLAN"] = fault_plan
+    if args.dryrun:
+        flags = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        # the elastic legs shrink to half the fleet: 4 devices -> half=2
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+    print(f"== serve leg {name!r} (plan {fault_plan!r})", file=sys.stderr)
+    proc = subprocess.run(
+        cmd, env=env, cwd=_REPO_ROOT, capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        print(proc.stdout, file=sys.stderr)
+        print(proc.stderr, file=sys.stderr)
+        raise SystemExit(f"serve leg {name!r} failed rc={proc.returncode}")
+    with open(os.path.join(out, "serve_summary.json")) as f:
+        return json.load(f)
+
+
+def serve_soak(args) -> int:
+    """Reference-vs-chaos serving pairs: the isolation/overload/elasticity
+    acceptance proof (module docstring).  Returns the process exit code."""
+    from stencil_tpu.telemetry.flight import FlightRecorder
+    from stencil_tpu.utils.artifact import atomic_write_json
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    flight = FlightRecorder(args.out_dir, label="serve_soak")
+    elastic = [
+        "--elastic", "--elastic-high", "4", "--elastic-low", "0",
+        "--elastic-consecutive", "3",
+    ]
+    flight.heartbeat(0, 6, stage="reference")
+    ref = serve_leg(args, "ref", [])
+    flight.heartbeat(1, 6, stage="poison")
+    poison = serve_leg(
+        args, "poison", [],
+        fault_plan="execute:poison_request:serve:tenant-b@1",
+    )
+    flight.heartbeat(2, 6, stage="vmem")
+    vmem = serve_leg(
+        args, "vmem", [], fault_plan="execute:vmem_oom:serve:tenant-c@1"
+    )
+    flight.heartbeat(3, 6, stage="overload")
+    overload = serve_leg(
+        args, "overload", [], fault_plan="dispatch:overload:serve:*@2*3"
+    )
+    flight.heartbeat(4, 6, stage="elastic")
+    el = serve_leg(args, "elastic", elastic)
+    flight.heartbeat(5, 6, stage="elastic-fixed")
+    el_fix = serve_leg(args, "elastic_fixed", elastic + ["--fixed-mesh"])
+
+    def states(doc):
+        return {t["tenant"]: t["state"] for t in doc["tenants"]}
+
+    def healthy_identical(doc, faulted):
+        return all(
+            doc["digests"][t] == ref["digests"][t]
+            for t in ref["digests"]
+            if t != faulted
+        )
+
+    checks = {
+        # the poisoned tenant is evicted/quarantined, nobody else moves a bit
+        "poison_isolated": states(poison)["tenant-b"] != "active"
+        and healthy_identical(poison, "tenant-b"),
+        # the OOMing tenant is answered inside its own envelope
+        "vmem_isolated": (
+            states(vmem)["tenant-c"] != "active"
+            or any(
+                t["rung"] > 0 for t in vmem["tenants"] if t["tenant"] == "tenant-c"
+            )
+        )
+        and healthy_identical(vmem, "tenant-c"),
+        # overload sheds load, never tenants
+        "overload_sheds_not_evicts": overload["shed"] >= 1
+        and all(s == "active" for s in states(overload).values()),
+        # elasticity: exactly one grow + one shrink, bitwise = fixed mesh
+        "elastic_bitwise": el["digests"] == el_fix["digests"],
+        "elastic_one_grow_one_shrink": el["elasticity"]["decisions"]
+        == ["grow", "shrink"]
+        and sorted({t["kind"] for t in el["elasticity"]["transitions"]})
+        == ["grow", "shrink"],
+    }
+    isolation_ok = all(checks.values())
+    summary = {
+        "bench": "serve_soak",
+        "dryrun": bool(args.dryrun),
+        "cycles": args.serve_cycles,
+        "tenants": ref["tenants"],
+        "requests": ref["requests"],
+        "p99_ms": ref["p99_ms"],
+        "shed_rate": ref["shed_rate"],
+        "overload_shed": overload["shed"],
+        "checks": checks,
+        "digests": {
+            "ref": ref["digests"],
+            "poison": poison["digests"],
+            "vmem": vmem["digests"],
+            "elastic": el["digests"],
+            "elastic_fixed": el_fix["digests"],
+        },
+        "elasticity": el["elasticity"],
+        "isolation_ok": isolation_ok,
+    }
+    path = os.path.join(args.out_dir, "serve_summary.json")
+    atomic_write_json(path, summary)
+    print(json.dumps(summary))
+    flight.heartbeat(
+        6, 6, phase="completed" if isolation_ok else "failed",
+        stage="verify", isolation_ok=isolation_ok,
+    )
+    if not isolation_ok:
+        failed = [k for k, ok in checks.items() if not ok]
+        flight.crash_report(
+            "serve_isolation", error=f"failed checks: {failed}",
+            checks=checks,
+        )
+        print(f"FAIL: serve soak checks failed: {failed}", file=sys.stderr)
+        return 1
+    print(
+        "OK: poison/vmem isolated bitwise, overload shed "
+        f"{overload['shed']} without evictions, elasticity one grow + one "
+        f"shrink bitwise identical ({path})",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.serve:
+        return serve_soak(args)
     if args.iters < args.kills + 2:
         raise SystemExit("--iters must leave room for every kill plus a resume")
     os.makedirs(args.out_dir, exist_ok=True)

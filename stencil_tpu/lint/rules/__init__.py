@@ -14,6 +14,7 @@ from stencil_tpu.lint.rules import (  # noqa: F401
     env_reads,
     jax_free,
     layout_traps,
+    serve_invariants,
     span_name,
     telemetry_names,
     tier1_budget,

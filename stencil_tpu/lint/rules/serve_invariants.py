@@ -1,0 +1,117 @@
+"""Rule ``bounded-queue``: no unbounded buffering in the serving layer.
+
+The serving layer's overload contract (docs/serving.md "Shedding policy")
+is that load past capacity becomes a CLASSIFIED, retryable refusal at the
+edge — never silent queue growth.  An unbounded queue converts overload
+into latency collapse and OOM: every request "succeeds" into a buffer
+whose wait time is already past any deadline, and the process dies of
+memory instead of shedding.  The invariant is structural, so it lints:
+
+* ``collections.deque(...)`` (or bare ``deque(...)``) without a ``maxlen``
+  keyword is flagged — a deque WITH ``maxlen`` is bounded by construction;
+* ``queue.Queue(...)`` / ``queue.SimpleQueue()`` (and the
+  ``LifoQueue``/``PriorityQueue`` variants) without a positive ``maxsize``
+  are flagged — ``Queue()``'s default ``maxsize=0`` means unbounded.
+
+Scope: ``stencil_tpu/serve/`` only.  Elsewhere a deque is a scratch
+structure bounded by its producer (e.g. the telemetry event ring caps
+itself); inside the serving layer every buffer sits on the request path,
+where "the producer bounds it" is exactly the assumption overload breaks.
+A deliberately unbounded serve-side structure suppresses with a reason,
+as always.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from stencil_tpu.lint.framework import FileContext, Rule, Violation, register
+
+#: queue.* constructors whose default is unbounded
+_QUEUE_CLASSES = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+
+
+def _call_name(node: ast.Call) -> Optional[tuple]:
+    """("deque", None) / ("queue", "Queue") style (module, attr) id for
+    the constructors this rule audits, else None."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        if f.id == "deque":
+            return ("collections", "deque")
+        if f.id in _QUEUE_CLASSES:
+            return ("queue", f.id)
+        return None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id == "collections" and f.attr == "deque":
+            return ("collections", "deque")
+        if f.value.id == "queue" and f.attr in _QUEUE_CLASSES:
+            return ("queue", f.attr)
+    return None
+
+
+def _bounded(node: ast.Call, kind: tuple) -> bool:
+    if kind == ("collections", "deque"):
+        # deque(iterable, maxlen) positionally, or maxlen= keyword; a
+        # maxlen of literal None is unbounded by definition
+        if len(node.args) >= 2:
+            return not (
+                isinstance(node.args[1], ast.Constant)
+                and node.args[1].value is None
+            )
+        for kw in node.keywords:
+            if kw.arg == "maxlen":
+                return not (
+                    isinstance(kw.value, ast.Constant) and kw.value.value is None
+                )
+        return False
+    if kind[1] == "SimpleQueue":
+        return False  # SimpleQueue has no maxsize at all
+    # queue.Queue(maxsize) / maxsize= — the default 0 means unbounded, and
+    # a literal 0 or negative spells it explicitly
+    size = None
+    if node.args:
+        size = node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "maxsize":
+            size = kw.value
+    if size is None:
+        return False
+    if isinstance(size, ast.Constant) and isinstance(size.value, (int, float)):
+        return size.value > 0
+    return True  # a computed bound: trust the expression names one
+
+
+@register
+class BoundedQueueRule(Rule):
+    name = "bounded-queue"
+    why = (
+        "an unbounded queue in the serving layer turns overload into "
+        "latency collapse + OOM instead of a classified refusal; construct "
+        "deques with maxlen= and queue.Queue with a positive maxsize"
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.replace("\\", "/").startswith("stencil_tpu/serve/")
+
+    def check(self, ctx: FileContext) -> List[Violation]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _call_name(node)
+            if kind is None or _bounded(node, kind):
+                continue
+            ctor = ".".join(kind)
+            out.append(
+                ctx.violation(
+                    self.name,
+                    node,
+                    f"unbounded {ctor}(...) on the request path — overload "
+                    "must become a classified refusal at the edge, not "
+                    "silent buffering; pass maxlen=/a positive maxsize (or "
+                    "suppress with the reason this buffer is bounded by "
+                    "construction elsewhere)",
+                )
+            )
+        return out

@@ -6,6 +6,7 @@
     phase := compile | execute | dispatch | any
     class := vmem_oom | compile_reject | transient | divergence | fatal
            | capacity_loss | sigkill | sigterm | shrink | grow
+           | overload | poison_request | slow_tenant
 
 Each entry first lets ``skip`` matching hook calls pass untouched (default
 0 — the chaos harness's "die at the K-th dispatch" primitive), then fires
@@ -53,6 +54,18 @@ name: ``jacobi``, ``astaroth``).  Examples:
            taxonomy's CAPACITY_LOSS class, exercising the supervisor's
            reshard-or-restore routing rather than the polite drain
 
+    STENCIL_FAULT_PLAN='execute:poison_request:serve:tenant-b'
+        -> tenant-b's next served request raises a typed DivergenceError
+           (a request whose execution diverges) — the serving layer's
+           per-tenant envelope quarantines/evicts ONLY that tenant, the
+           isolation property the serving chaos soak proves bitwise.
+           'overload' raises the pinned queue-full shed wording (the
+           taxonomy's OVERLOAD class; never blindly retried), and
+           'slow_tenant' delivers a slowdown notice to the registered
+           slow handler (``set_slow_handler``; the serving layer installs
+           one that inflates that request's service time) — like the
+           capacity notices, no handler = log and drop, never a crash
+
 Injected VMEM_OOM / COMPILE_REJECT / TRANSIENT faults are raised as
 ``InjectedFault`` with the SAME message wording the real toolchain emits, so
 they flow through ``classify()``'s substring matching exactly like the real
@@ -90,6 +103,12 @@ _CLASSES = {
     "transient": FailureClass.TRANSIENT_RUNTIME,
     "divergence": FailureClass.DIVERGENCE,
     "capacity_loss": FailureClass.CAPACITY_LOSS,
+    "overload": FailureClass.OVERLOAD,
+    # a request whose EXECUTION diverges: same typed DivergenceError as
+    # 'divergence' (the serving layer's eviction path keys on the class,
+    # not the plan-entry spelling), but the chaos grammar keeps the
+    # serving-native name so soak plans read as what they model
+    "poison_request": FailureClass.DIVERGENCE,
     "fatal": FailureClass.FATAL,
 }
 #: process-level kill classes: a REAL signal to this process, not an
@@ -104,6 +123,11 @@ _KILLS = ("sigkill", "sigterm")
 #: logged and dropped — a fault plan must never crash an unsupervised run
 #: with a primitive only the supervisor can answer.
 _CAPACITY = ("shrink", "grow")
+#: seeded tenant slowdowns: no exception — the hook calls the REGISTERED
+#: slow handler (``set_slow_handler``; the serving layer installs one that
+#: inflates the matched request's service time), modeling a tenant whose
+#: requests hog dispatch slots without failing.  No handler = log + drop.
+_SLOW = ("slow_tenant",)
 
 #: The message each injected class carries — the REAL toolchain wording (the
 #: same texts ``taxonomy`` pins), tagged with the injection site.
@@ -121,6 +145,9 @@ _MESSAGES = {
     FailureClass.CAPACITY_LOSS: (
         "UNAVAILABLE: TPU is unhealthy: lost device at coordinates [0,1,0]"
     ),
+    # the serving layer's own pinned refusal wording (OverloadError's
+    # queue-full text — taxonomy._OVERLOAD_MARKERS match it)
+    FailureClass.OVERLOAD: "request queue is full; load shed",
     FailureClass.FATAL: "injected fatal failure",
 }
 
@@ -131,6 +158,7 @@ class _Entry:
     cls: Optional[FailureClass]  # None for the process-kill classes
     kill: Optional[str]  # "sigkill" | "sigterm" | None
     capacity: Optional[str]  # "shrink" | "grow" | None
+    slow: Optional[str]  # "slow_tenant" | None
     label_glob: str
     skip: int
     remaining: int
@@ -170,17 +198,23 @@ def _parse_entry(text: str) -> _Entry:
         raise ValueError(
             f"{ENV_VAR}: unknown phase {phase!r} (one of {', '.join(_PHASES)})"
         )
-    if cls_name not in _CLASSES and cls_name not in _KILLS and cls_name not in _CAPACITY:
+    if (
+        cls_name not in _CLASSES
+        and cls_name not in _KILLS
+        and cls_name not in _CAPACITY
+        and cls_name not in _SLOW
+    ):
         raise ValueError(
             f"{ENV_VAR}: unknown failure class {cls_name!r} "
             f"(one of {', '.join(_CLASSES)}, {', '.join(_KILLS)}, "
-            f"{', '.join(_CAPACITY)})"
+            f"{', '.join(_CAPACITY)}, {', '.join(_SLOW)})"
         )
     return _Entry(
         phase,
         _CLASSES.get(cls_name),
         cls_name if cls_name in _KILLS else None,
         cls_name if cls_name in _CAPACITY else None,
+        cls_name if cls_name in _SLOW else None,
         label_glob.strip() or "*",
         skip,
         count,
@@ -231,6 +265,9 @@ class FaultPlan:
             if e.capacity is not None:
                 _capacity_notice(e.capacity, phase, label)
                 return  # a notice, not a failure; the dispatch proceeds
+            if e.slow is not None:
+                _slow_notice(phase, label)
+                return  # a slowdown, not a failure; the dispatch proceeds
             _raise(e.cls, phase, label)
 
 
@@ -286,6 +323,42 @@ def _capacity_notice(kind: str, phase: str, label: str) -> None:
         )
         return
     fn(kind, phase, label)
+
+
+#: the registered tenant-slowdown handler (``fn(phase, label)``), installed
+#: by the serving layer for the duration of a serve run — jax-free module
+#: state, exactly like the capacity handler above
+_slow_handler = {"fn": None}
+
+
+def set_slow_handler(fn) -> object:
+    """Install (or clear, with ``None``) the slow-tenant handler; returns
+    the previous handler so nested serve runs can restore."""
+    prev = _slow_handler["fn"]
+    _slow_handler["fn"] = fn
+    return prev
+
+
+def _slow_notice(phase: str, label: str) -> None:
+    """Deliver a seeded slow-tenant notice to the registered handler (the
+    serving layer inflates the matched request's service time).  No handler
+    = log and drop — the primitive only means something to a serve run."""
+    from stencil_tpu import telemetry
+    from stencil_tpu.telemetry import names as tm
+    from stencil_tpu.utils.logging import log_warn
+
+    telemetry.inc(tm.FAULTS_INJECTED)
+    telemetry.emit_event(
+        tm.EVENT_FAULT, phase=phase, label=label, failure_class="slow_tenant"
+    )
+    fn = _slow_handler["fn"]
+    if fn is None:
+        log_warn(
+            f"slow_tenant notice injected at {phase}:{label} but no handler "
+            "is registered (no serving layer running); dropped"
+        )
+        return
+    fn(phase, label)
 
 
 def _raise(cls: FailureClass, phase: str, label: str) -> None:

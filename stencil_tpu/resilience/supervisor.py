@@ -332,6 +332,19 @@ class RunSupervisor:
             "boundary"
         )
 
+    def request_capacity(self, kind: str, source: str = "policy") -> None:
+        """Public capacity entry for load-driven elasticity (``serve/``
+        policies call this with ``grow``/``shrink``).  Notices coalesce:
+        the pending request is a LAST-WINS slot answered once at the next
+        chunk boundary, so a SIGUSR1 refit, a seeded capacity notice, and
+        a policy reshard landing in the same chunk window produce ONE
+        drain+reshard, not three (pinned by tests/test_supervisor.py)."""
+        if kind not in ("grow", "shrink", "refit"):
+            raise ValueError(
+                f"request_capacity: kind must be grow/shrink/refit, got {kind!r}"
+            )
+        self._on_capacity_notice(kind, "request", source)
+
     def _capacity_target(self, kind: str) -> Optional[list]:
         """Target devices for a capacity change, or None for a no-op.
         ``grow``/``refit`` re-fit to the full visible fleet; ``shrink``

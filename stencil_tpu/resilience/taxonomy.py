@@ -1,6 +1,6 @@
 """Failure taxonomy: one ``classify(exc)`` for every error-handling site.
 
-Eight classes cover everything the framework reacts to differently:
+Nine classes cover everything the framework reacts to differently:
 
 * ``VMEM_OOM``          — Mosaic rejected a kernel because its scoped-VMEM
   request does not fit (the calibrated model under-estimated on this
@@ -38,6 +38,17 @@ Eight classes cover everything the framework reacts to differently:
   capacity").  The markers are checked BEFORE the transient list because
   real device-loss wordings carry the gRPC ``UNAVAILABLE:`` prefix that
   would otherwise classify them retryable.
+* ``OVERLOAD``          — the SERVING layer refused or shed the request
+  because the fleet is saturated: the admission queue is full, the request's
+  deadline passed while queued, or a cold compile would not fit the
+  admission budget (``serve/``).  Never retried blindly — N tenants
+  re-dispatching into a saturated queue is the thundering herd that caused
+  the shed; the caller backs off (the refusal carries ``retry_after_s``)
+  or lowers its request rate.  Distinct from TRANSIENT_RUNTIME even though
+  both are "try later": transient retries re-run the SAME work in place,
+  an overload refusal pushes the decision back to the submitting tenant.
+  The markers are checked BEFORE the transient list because shed wordings
+  mention the deadline ("deadline exceeded" is a transient marker).
 * ``FATAL``             — everything else.  Propagates unchanged.
 
 Classification is by exception type first (``ResilienceError`` subclasses
@@ -60,6 +71,7 @@ class FailureClass(enum.Enum):
     PREEMPTED = "preempted"
     STALL = "stall"
     CAPACITY_LOSS = "capacity_loss"
+    OVERLOAD = "overload"
     FATAL = "fatal"
 
 
@@ -103,6 +115,45 @@ class DivergenceError(ResilienceError):
                 f"{self.window[1]}]"
             )
         super().__init__(msg + " (divergence sentinel)")
+
+
+class OverloadError(ResilienceError):
+    """The serving layer refused or shed a request under load (``serve/``).
+    Carries WHY (``queue_full`` / ``deadline`` / ``compile_budget``), the
+    queue depth observed at refusal time, and a backoff hint the caller
+    should honor before re-submitting — blind immediate re-dispatch is the
+    herd behavior the shed exists to break."""
+
+    failure_class = FailureClass.OVERLOAD
+
+    def __init__(
+        self,
+        why: str = "queue_full",
+        queue_depth: int = None,
+        retry_after_s: float = None,
+        tenant: str = None,
+    ):
+        self.why = why
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
+        self.tenant = tenant
+        # pinned wordings (matched by _OVERLOAD_MARKERS below and pinned by
+        # tests): every refusal path names its cause in the message
+        if why == "queue_full":
+            msg = "request queue is full; load shed"
+        elif why == "deadline":
+            msg = "request deadline exceeded while queued; load shed"
+        elif why == "compile_budget":
+            msg = "cold compile exceeded the admission budget; load shed"
+        else:
+            msg = f"serving overload ({why}); load shed"
+        if tenant is not None:
+            msg += f" [tenant {tenant}]"
+        if queue_depth is not None:
+            msg += f" (queue depth {queue_depth})"
+        if retry_after_s is not None:
+            msg += f"; retry after {retry_after_s:.2f}s"
+        super().__init__(msg)
 
 
 class PreemptionError(ResilienceError):
@@ -204,6 +255,18 @@ _CAPACITY_MARKERS = (
     "device has been removed",
 )
 
+#: Serving-layer overload refusals (``serve/`` — bounded-queue rejection,
+#: queued-past-deadline shed, cold-compile-over-budget refusal).  Checked
+#: BEFORE the transient list: the deadline-shed wording contains "deadline
+#: exceeded", which would otherwise classify a shed as a retry-in-place
+#: transient — exactly the blind re-dispatch the OVERLOAD class forbids.
+#: Wordings are OURS (OverloadError pins them), not a toolchain's, so they
+#: are chosen to be unmistakable: "load shed" appears in every refusal.
+_OVERLOAD_MARKERS = (
+    "load shed",
+    "request queue is full",
+)
+
 #: Non-VMEM Mosaic/XLA capability rejections observed by this repo's probes
 #: (each wording is pinned by tests):
 #:   "Target does not support this comparison"    (16-bit vector compare)
@@ -257,6 +320,11 @@ def classify(exc: BaseException) -> FailureClass:
     # not a retry, it is a hang with extra steps (pinned by tests)
     if any(m in msg for m in _CAPACITY_MARKERS):
         return FailureClass.CAPACITY_LOSS
+    # overload BEFORE transient: a deadline shed's wording mentions the
+    # exceeded deadline, and a retry-in-place against a saturated queue is
+    # the thundering herd the shed exists to break (pinned by tests)
+    if any(m in msg for m in _OVERLOAD_MARKERS):
+        return FailureClass.OVERLOAD
     if any(m in msg for m in _TRANSIENT_MARKERS):
         return FailureClass.TRANSIENT_RUNTIME
     if any(m in msg for m in _COMPILE_REJECT_MARKERS):

@@ -1,0 +1,45 @@
+"""Multi-tenant serving layer: admission control, tenant fault isolation,
+overload shedding, load-driven elasticity.
+
+Public surface (docs/serving.md):
+
+* :class:`TenantSpec` / :class:`Request` / :class:`Response` — the
+  request/response vocabulary (``request.py``);
+* :class:`AdmissionRefused` — the classified admission refusal;
+* :class:`BoundedQueue` — the deadline-propagating admission queue;
+* :class:`AOTCache` — warm executables by ``tune/key.py`` digest;
+* :class:`Tenant` — the per-tenant resilience envelope;
+* :class:`ElasticityPolicy` — queue depth -> grow/shrink with hysteresis;
+* :class:`StencilServer` — the serving loop tying them together.
+
+The driver is ``python -m stencil_tpu.bin.stencil_serve`` (synthetic load
+generator included); the serving chaos soak is ``scripts/run_soak.py
+--serve``.
+"""
+
+from stencil_tpu.serve.aot import AOTCache
+from stencil_tpu.serve.policy import ElasticityPolicy
+from stencil_tpu.serve.queue import BoundedQueue
+from stencil_tpu.serve.request import (
+    AdmissionRefused,
+    Request,
+    Response,
+    TenantSpec,
+)
+from stencil_tpu.serve.server import StencilServer
+from stencil_tpu.serve.tenant import ACTIVE, EVICTED, QUARANTINED, Tenant
+
+__all__ = [
+    "ACTIVE",
+    "AOTCache",
+    "AdmissionRefused",
+    "BoundedQueue",
+    "ElasticityPolicy",
+    "EVICTED",
+    "QUARANTINED",
+    "Request",
+    "Response",
+    "StencilServer",
+    "Tenant",
+    "TenantSpec",
+]

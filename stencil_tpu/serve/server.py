@@ -1,0 +1,366 @@
+"""The serving loop: admission, fair dispatch, per-tenant envelopes,
+shedding, elasticity.
+
+One ``StencilServer`` owns many tenants on one fleet.  The life of a
+request (docs/serving.md):
+
+1. ``submit`` — ADMISSION: the tenant must be active, the static VMEM
+   verdict (``analysis.check_vmem``) must pass for the tenant's declared
+   plan, the request's workload key must be warm in the AOT cache (or
+   compile under the admission budget), and the bounded queue must yield a
+   slot (shedding expired and, for a higher-priority arrival, the lowest-
+   priority queued request first).  Refusals are CLASSIFIED: load refusals
+   are ``OverloadError`` (retryable after backoff), verdict refusals are
+   ``AdmissionRefused`` carrying VMEM_OOM (degradable: re-submit a
+   shallower plan), evicted-tenant refusals are FATAL.
+2. ``cycle`` — DISPATCH: shed whatever expired while queued, then serve
+   the oldest request of the next tenant in round-robin rotation, retries
+   charged to that tenant's shared budget, every classified failure
+   answered inside that tenant's envelope (``tenant.py``) — no failure of
+   tenant A ever touches tenant B's state or fields.
+3. after every cycle the elasticity policy observes the queue depth; a
+   grow/shrink decision routes through ``capacity`` (the supervisor's
+   coalescing ``request_capacity``, or a direct reshard callback) — the
+   server never touches a mesh itself.
+
+The clock and sleep are injectable so the tier-1 twins drive deadlines,
+backoff, and slow-tenant penalties with a fake clock and zero real sleeps.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Dict, List, Optional
+
+from stencil_tpu import telemetry
+from stencil_tpu.resilience import inject
+from stencil_tpu.resilience.retry import RetryPolicy, execute_with_retry
+from stencil_tpu.resilience.taxonomy import (
+    FailureClass,
+    OverloadError,
+    classify,
+)
+from stencil_tpu.serve.aot import AOTCache
+from stencil_tpu.serve.queue import BoundedQueue
+from stencil_tpu.serve.request import AdmissionRefused, Request, Response, TenantSpec
+from stencil_tpu.serve.tenant import Tenant
+from stencil_tpu.telemetry import names as tm
+from stencil_tpu.utils.logging import log_info, log_warn
+
+
+class StencilServer:
+    """Admission + fair dispatch + isolation envelopes over one fleet."""
+
+    def __init__(
+        self,
+        queue_max: int = 64,
+        default_deadline_s: Optional[float] = None,
+        compile_budget_s: Optional[float] = None,
+        policy=None,
+        capacity: Optional[Callable[[str], None]] = None,
+        aot: Optional[AOTCache] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+        flight=None,
+        slow_penalty_s: float = 0.25,
+    ):
+        self.tenants: Dict[str, Tenant] = {}
+        self.queue = BoundedQueue(queue_max)
+        self.default_deadline_s = default_deadline_s
+        self.compile_budget_s = compile_budget_s
+        self.policy = policy
+        self.capacity = capacity
+        self.aot = aot if aot is not None else AOTCache(clock=clock)
+        self.retry_policy = retry_policy
+        self.clock = clock
+        self.sleep = sleep
+        self.rng = rng
+        self.flight = flight
+        self.slow_penalty_s = slow_penalty_s
+        self._rotation: List[str] = []
+        self._builders: Dict[str, Callable] = {}
+        self._slow_pending = False
+        self._completed_total = 0
+        self._prev_slow_handler = inject.set_slow_handler(self._on_slow)
+
+    def close(self) -> None:
+        """Restore the previous slow-tenant hook (pair with construction)."""
+        inject.set_slow_handler(self._prev_slow_handler)
+
+    # --- tenants --------------------------------------------------------------
+
+    def add_tenant(self, spec: TenantSpec, model=None) -> Tenant:
+        if spec.tenant_id in self.tenants:
+            raise ValueError(f"tenant {spec.tenant_id!r} already registered")
+        t = Tenant(spec, model)
+        self.tenants[spec.tenant_id] = t
+        self._rotation.append(spec.tenant_id)
+        self._gauge_tenants()
+        return t
+
+    def register_workload(self, digest: str, build: Callable[[], object]) -> None:
+        """Associate an AOT build (``jax.jit(...).lower().compile()``
+        inside) with a workload-key digest so admission can warm it."""
+        self._builders[digest] = build
+
+    def _gauge_tenants(self) -> None:
+        telemetry.set_gauge(
+            tm.SERVE_TENANTS_ACTIVE,
+            sum(1 for t in self.tenants.values() if t.active()),
+        )
+
+    # --- admission ------------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        """Admit ``req`` into the queue or raise a classified refusal."""
+        now = self.clock()
+        tenant = self.tenants.get(req.tenant)
+        if tenant is None:
+            self._reject(req, "unknown tenant", "fatal")
+            raise AdmissionRefused(
+                "unknown tenant", FailureClass.FATAL, tenant=req.tenant
+            )
+        if not tenant.active():
+            why = f"tenant is {tenant.state} ({tenant.why})"
+            self._reject(req, why, "fatal")
+            raise AdmissionRefused(why, FailureClass.FATAL, tenant=req.tenant)
+        # static VMEM verdict: reject a plan the compiler would refuse
+        # BEFORE it can waste a dispatch slot failing (analysis/vmem.py)
+        if tenant.spec.plan is not None and getattr(tenant.model, "dd", None) is not None:
+            from stencil_tpu.analysis import check_vmem
+
+            reason = check_vmem(tenant.model.dd, tenant.spec.plan)
+            if reason is not None:
+                self._reject(req, reason, FailureClass.VMEM_OOM.value)
+                raise AdmissionRefused(
+                    reason, FailureClass.VMEM_OOM, tenant=req.tenant
+                )
+        compile_s = self._warm_key(req)
+        if req.deadline_s is None and self.default_deadline_s is not None:
+            req.deadline_s = now + self.default_deadline_s
+        try:
+            self.queue.push(req, now)
+        except OverloadError:
+            # the shed ladder: expired first, then a lower-priority victim
+            # for a HIGHER-priority arrival; refuse only when neither opens
+            # a slot (queue.py module docstring)
+            for victim in self.queue.shed_expired(now):
+                self._shed(victim, "deadline", now)
+            if self.queue.full():
+                victim = self.queue.shed_lowest_priority(req.priority)
+                if victim is not None:
+                    self._shed(victim, "priority", now)
+            if self.queue.full():
+                telemetry.inc(tm.SERVE_REJECTED)
+                telemetry.emit_event(
+                    tm.EVENT_SERVE_ADMISSION,
+                    tenant=req.tenant,
+                    admitted=False,
+                    why="queue_full",
+                    queue_depth=self.queue.depth(),
+                )
+                raise
+            self.queue.push(req, now)
+        tenant.admitted += 1
+        telemetry.inc(tm.SERVE_ADMITTED)
+        telemetry.set_gauge(tm.SERVE_QUEUE_DEPTH, self.queue.depth())
+        telemetry.emit_event(
+            tm.EVENT_SERVE_ADMISSION,
+            tenant=req.tenant,
+            admitted=True,
+            why="ok",
+            queue_depth=self.queue.depth(),
+            compile_s=compile_s,
+        )
+
+    def _warm_key(self, req: Request) -> Optional[float]:
+        """AOT admission: a warm key is free; a cold key with a registered
+        build compiles under the admission budget (``aot.py`` owns the
+        over-budget refusal).  Returns the compile seconds paid, if any."""
+        digest = req.key_digest
+        if digest is None or self.aot.warm(digest):
+            return None
+        build = self._builders.get(digest)
+        if build is None:
+            return None  # no AOT contract for this key; the model self-compiles
+        budget = None if self.aot.stamped(digest) else self.compile_budget_s
+        try:
+            _, seconds = self.aot.compile(
+                digest, build, budget_s=budget, label=req.tenant
+            )
+        except OverloadError:
+            self._reject(req, "compile over budget", FailureClass.OVERLOAD.value)
+            raise
+        return seconds
+
+    def _reject(self, req: Request, why: str, cls: str) -> None:
+        telemetry.inc(tm.SERVE_REJECTED)
+        telemetry.emit_event(
+            tm.EVENT_SERVE_ADMISSION,
+            tenant=req.tenant,
+            admitted=False,
+            why=f"{cls}: {why}"[:300],
+            queue_depth=self.queue.depth(),
+        )
+
+    # --- shedding -------------------------------------------------------------
+
+    def _shed(self, req: Request, why: str, now: float) -> Response:
+        t = self.tenants.get(req.tenant)
+        if t is not None:
+            t.shed += 1
+        telemetry.inc(tm.SERVE_SHED)
+        telemetry.emit_event(
+            tm.EVENT_SERVE_SHED,
+            tenant=req.tenant,
+            why=why,
+            queue_depth=self.queue.depth(),
+            waited_s=max(0.0, now - req.enqueued_at),
+        )
+        log_warn(f"serve: shed {req.tenant} request (why={why})")
+        return Response(
+            request=req,
+            ok=False,
+            latency_s=max(0.0, now - req.enqueued_at),
+            error=f"request {why} shed",
+            failure_class=FailureClass.OVERLOAD.value,
+        )
+
+    # --- dispatch -------------------------------------------------------------
+
+    def _on_slow(self, phase: str, label: str) -> None:
+        # the seeded slow_tenant notice (inject.py): inflate the CURRENT
+        # request's service time by the penalty at dispatch time
+        self._slow_pending = True
+
+    def cycle(self) -> List[Response]:
+        """One dispatch cycle: shed expired, serve one request fairly,
+        observe the elasticity policy.  Returns every response produced
+        (shed responses included); empty list = nothing queued."""
+        now = self.clock()
+        out = [self._shed(r, "deadline", now) for r in self.queue.shed_expired(now)]
+        req = self.queue.take(self._rotation)
+        if req is not None:
+            out.append(self._dispatch(req))
+            # rotate AFTER serving: the served tenant goes to the back
+            if req.tenant in self._rotation:
+                self._rotation.remove(req.tenant)
+                self._rotation.append(req.tenant)
+        depth = self.queue.depth()
+        telemetry.set_gauge(tm.SERVE_QUEUE_DEPTH, depth)
+        if self.policy is not None:
+            kind = self.policy.observe(depth, self.clock())
+            if kind is not None:
+                telemetry.emit_event(
+                    tm.EVENT_SERVE_ELASTICITY,
+                    kind=kind,
+                    queue_depth=depth,
+                    source="policy",
+                )
+                log_info(f"serve: elasticity policy requests {kind} (depth {depth})")
+                if self.capacity is not None:
+                    self.capacity(kind)
+        return out
+
+    def _dispatch(self, req: Request) -> Response:
+        tenant = self.tenants[req.tenant]
+        label = f"serve:{req.tenant}"
+        attempts = [0]
+
+        def work():
+            attempts[0] += 1
+            inject.maybe_fail("execute", label)
+            if self._slow_pending:
+                # a seeded slow tenant: its request hogs its slot for the
+                # penalty — charged to THIS request's latency only
+                self._slow_pending = False
+                self.sleep(self.slow_penalty_s)
+            if tenant.model is not None:
+                tenant.model.step(req.steps)
+
+        try:
+            inject.maybe_fail("dispatch", label)
+            execute_with_retry(
+                work,
+                label=label,
+                policy=self.retry_policy,
+                budget=tenant.budget,
+                sleep=self.sleep,
+                rng=self.rng,
+            )
+        except Exception as e:  # noqa: BLE001 — classified right below
+            return self._on_dispatch_failure(req, tenant, e, attempts[0])
+        now = self.clock()
+        latency = max(0.0, now - req.enqueued_at)
+        tenant.completed += 1
+        tenant.retries += max(0, attempts[0] - 1)
+        tenant.latency.insert(latency)
+        self._completed_total += 1
+        telemetry.inc(tm.SERVE_COMPLETED)
+        telemetry.observe(tm.SERVE_LATENCY_SECONDS, latency)
+        self._heartbeat()
+        return Response(
+            request=req, ok=True, latency_s=latency, steps_done=req.steps
+        )
+
+    def _on_dispatch_failure(
+        self, req: Request, tenant: Tenant, e: Exception, attempts: int
+    ) -> Response:
+        now = self.clock()
+        cls = classify(e)
+        tenant.retries += max(0, attempts - 1)
+        if cls is FailureClass.OVERLOAD:
+            # an injected overload at the dispatch hook: shed THIS request,
+            # never evict the (healthy) tenant it happened to land on
+            return self._shed(req, "injected", now)
+        action = tenant.handle_failure(cls, str(e))
+        if action == "evict":
+            telemetry.inc(tm.SERVE_EVICTED)
+            telemetry.emit_event(
+                tm.EVENT_SERVE_EVICTION,
+                tenant=req.tenant,
+                failure_class=cls.value,
+                why=str(e)[:300],
+            )
+            log_warn(
+                f"serve: tenant {req.tenant} quarantined after {cls.value}: {e}"
+            )
+            self._gauge_tenants()
+        elif action == "propagate" and cls is FailureClass.PREEMPTED:
+            raise e  # a preemption outranks serving bookkeeping
+        self._heartbeat()
+        return Response(
+            request=req,
+            ok=False,
+            latency_s=max(0.0, now - req.enqueued_at),
+            error=str(e)[:300],
+            failure_class=cls.value,
+        )
+
+    # --- loops + reporting ----------------------------------------------------
+
+    def drain(self, max_cycles: int = 10_000) -> List[Response]:
+        """Cycle until the queue is empty (or the cycle bound trips —
+        never an unbounded loop inside a bounded-queue package)."""
+        out: List[Response] = []
+        for _ in range(max_cycles):
+            if self.queue.depth() == 0:
+                break
+            out.extend(self.cycle())
+        return out
+
+    def tenant_table(self) -> List[dict]:
+        return [t.table_row() for t in self.tenants.values()]
+
+    def _heartbeat(self) -> None:
+        if self.flight is None:
+            return
+        self.flight.heartbeat(
+            self._completed_total,
+            phase="serving",
+            queue_depth=self.queue.depth(),
+            tenants=self.tenant_table(),
+        )

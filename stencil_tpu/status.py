@@ -76,6 +76,32 @@ def _numerics_lines(doc, indent: str = "  ") -> list:
     return lines
 
 
+def _tenant_lines(rows, indent: str = "  ") -> list:
+    """The serving heartbeat's tenant table (serve/server.py
+    ``tenant_table`` rows): one aligned line per tenant — state, envelope
+    rung, request counters, and the per-tenant latency percentiles."""
+    if not isinstance(rows, list) or not rows:
+        return []
+    lines = [indent + "tenants:"]
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        pcts = "/".join(
+            _fmt_stat(row.get(k)) for k in ("p50_ms", "p95_ms", "p99_ms")
+        )
+        line = (
+            f"{indent}  {row.get('tenant', '?')}: {row.get('state', '?')}"
+            f" prio {row.get('priority', 0)}, rung {row.get('rung', 0)},"
+            f" {row.get('completed', 0)}/{row.get('admitted', 0)} done,"
+            f" shed {row.get('shed', 0)}, retries {row.get('retries', 0)},"
+            f" p50/p95/p99 {pcts} ms"
+        )
+        if row.get("why"):
+            line += f" [{row['why']}]"
+        lines.append(line)
+    return lines
+
+
 def render(status, crash, stale_after: float = 300.0) -> str:
     """The human view of one run directory's flight state."""
     lines = []
@@ -109,6 +135,7 @@ def render(status, crash, stale_after: float = 300.0) -> str:
             ("watchdog", "watchdog"),
             ("mesh", "mesh"),
             ("mesh_transitions", "mesh transitions"),
+            ("queue_depth", "queue depth"),
         ):
             if status.get(key) is not None:
                 val = status[key]
@@ -132,6 +159,8 @@ def render(status, crash, stale_after: float = 300.0) -> str:
         # numerics observatory: the heartbeat's last per-quantity health
         # snapshot (docs/observability.md "Numerics observatory")
         lines.extend(_numerics_lines(status.get("numerics")))
+        # serving heartbeats carry the per-tenant table (docs/serving.md)
+        lines.extend(_tenant_lines(status.get("tenants")))
         if status.get("last_error"):
             lines.append(f"  last error: {status['last_error']}")
     if crash is not None:

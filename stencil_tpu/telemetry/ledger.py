@@ -181,6 +181,28 @@ def entries_from_artifact(path: str) -> List[dict]:
             )
         return [e for e in out if e is not None]
 
+    if isinstance(doc, dict) and doc.get("bench") == "serve_soak":
+        # the serving chaos soak / load-generator artifact (run_soak.py
+        # --serve, bin/stencil_serve.py): fleet-wide p99 latency and the
+        # shed rate — both LOWER-is-better SLO series.  Only soaks whose
+        # isolation verdict held land: a run where a poisoned tenant bled
+        # into its neighbors describes a broken server, not an SLO point.
+        if not doc.get("isolation_ok", doc.get("bitwise_identical")):
+            return []
+        out.append(
+            _entry(
+                ts, "serve:p99_ms", doc.get("p99_ms"), "ms", source,
+                better="lower", tenants=len(doc.get("tenants") or []),
+            )
+        )
+        out.append(
+            _entry(
+                ts, "serve:shed_rate", doc.get("shed_rate"), "", source,
+                better="lower", requests=doc.get("requests"),
+            )
+        )
+        return [e for e in out if e is not None]
+
     if isinstance(doc, dict) and doc.get("bench") == "exchange":
         # bench_exchange's route A/B (the packed-route wins): direct's
         # steady-state rate plus every packed route's speedup-vs-direct —

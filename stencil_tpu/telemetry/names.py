@@ -111,6 +111,20 @@ NUMERICS_SNAPSHOTS = "numerics.snapshots"
 #: drifted but stayed finite — observe-only unless STENCIL_NUMERICS_ABORT
 #: escalates).  Doubles as the event name: one constant, one series.
 NUMERICS_DRIFT = "numerics.drift"
+#: serving-layer requests ADMITTED past admission control (serve/server.py:
+#: VMEM verdict ok, executable warm or compiled under budget, queue slot)
+SERVE_ADMITTED = "serve.admitted"
+#: requests REFUSED at admission (static VMEM verdict, cold compile over
+#: budget, queue full, tenant quarantined/evicted)
+SERVE_REJECTED = "serve.rejected"
+#: queued requests SHED under load (past-deadline first, then lowest
+#: priority to make room for a higher-priority arrival)
+SERVE_SHED = "serve.shed"
+#: tenants evicted/quarantined by the per-tenant fault envelope (their
+#: DIVERGENCE — a poisoned request — must not touch other tenants)
+SERVE_EVICTED = "serve.evicted"
+#: requests served to completion
+SERVE_COMPLETED = "serve.completed"
 
 ALL_COUNTERS = frozenset({
     EXCHANGE_COUNT,
@@ -144,6 +158,11 @@ ALL_COUNTERS = frozenset({
     RESHARD_FALLBACKS,
     NUMERICS_SNAPSHOTS,
     NUMERICS_DRIFT,
+    SERVE_ADMITTED,
+    SERVE_REJECTED,
+    SERVE_SHED,
+    SERVE_EVICTED,
+    SERVE_COMPLETED,
 })
 
 # --- gauges (last-value) -----------------------------------------------------
@@ -154,7 +173,18 @@ EXCHANGE_BYTES_PER_EXCHANGE = "domain.exchange.bytes_per_exchange"
 #: ``keep``-bounded ring size — io/checkpoint.py ``save_to_ring``)
 CHECKPOINT_RETAINED = "checkpoint.retained"
 
-ALL_GAUGES = frozenset({EXCHANGE_BYTES_PER_EXCHANGE, CHECKPOINT_RETAINED})
+#: serving request-queue depth after each admission/dispatch (the signal
+#: the elasticity policy watches)
+SERVE_QUEUE_DEPTH = "serve.queue.depth"
+#: tenants currently in the "active" state (admitted, not quarantined)
+SERVE_TENANTS_ACTIVE = "serve.tenants.active"
+
+ALL_GAUGES = frozenset({
+    EXCHANGE_BYTES_PER_EXCHANGE,
+    CHECKPOINT_RETAINED,
+    SERVE_QUEUE_DEPTH,
+    SERVE_TENANTS_ACTIVE,
+})
 
 # --- histograms (Statistics-backed: min/max/avg/stddev/med/trimean) ----------
 
@@ -181,6 +211,13 @@ RESHARD_SECONDS = "reshard.seconds"
 #: readback — the "cheap enough to leave on" figure bench.py's
 #: numerics_overhead A/B regression-gates)
 NUMERICS_SNAPSHOT_SECONDS = "numerics.snapshot.seconds"
+#: end-to-end wall seconds per served request (enqueue -> response; the
+#: fleet-wide series — per-tenant p50/p95/p99 live in each tenant's own
+#: Statistics and surface through the heartbeat tenant table)
+SERVE_LATENCY_SECONDS = "serve.latency.seconds"
+#: wall seconds per AOT executable compile at admission (serve/aot.py —
+#: the cost the admission budget bounds)
+SERVE_COMPILE_SECONDS = "serve.compile.seconds"
 
 ALL_HISTOGRAMS = frozenset({
     STEP_SECONDS,
@@ -192,6 +229,8 @@ ALL_HISTOGRAMS = frozenset({
     CHECKPOINT_RESTORE_SECONDS,
     RESHARD_SECONDS,
     NUMERICS_SNAPSHOT_SECONDS,
+    SERVE_LATENCY_SECONDS,
+    SERVE_COMPILE_SECONDS,
 })
 
 # --- spans (Chrome-trace timeline entries) -----------------------------------
@@ -297,6 +336,18 @@ EVENT_RESHARD_FALLBACK = "reshard.fallback"
 #: sustained healthy progress restored one restart credit (fields: label,
 #: step, window, credits_used — STENCIL_RESTART_WINDOW)
 EVENT_SUPERVISOR_REPLENISH = "supervisor.replenish"
+#: an admission decision (fields: tenant, admitted, why, queue_depth,
+#: compile_s when a cold key compiled at admission)
+EVENT_SERVE_ADMISSION = "serve.admission"
+#: queued load was shed (fields: tenant, why=deadline|priority|injected,
+#: queue_depth, waited_s)
+EVENT_SERVE_SHED = "serve.load_shed"
+#: the per-tenant envelope quarantined/evicted a tenant (fields: tenant,
+#: failure_class, why)
+EVENT_SERVE_EVICTION = "serve.eviction"
+#: the load policy asked for capacity (fields: kind=grow|shrink,
+#: queue_depth, source)
+EVENT_SERVE_ELASTICITY = "serve.elasticity"
 
 ALL_EVENTS = frozenset({
     EVENT_COMPILE,
@@ -323,6 +374,10 @@ ALL_EVENTS = frozenset({
     EVENT_RESHARD,
     EVENT_RESHARD_FALLBACK,
     EVENT_SUPERVISOR_REPLENISH,
+    EVENT_SERVE_ADMISSION,
+    EVENT_SERVE_SHED,
+    EVENT_SERVE_EVICTION,
+    EVENT_SERVE_ELASTICITY,
     NUMERICS_DRIFT,
 })
 

@@ -1,0 +1,12 @@
+# lint-fixture: select=bounded-queue rel=stencil_tpu/serve/fake.py expect=clean
+# The sanctioned patterns: every serve-side buffer is bounded at the
+# constructor — maxlen= deques, positive-maxsize queues, computed bounds.
+import collections
+import queue
+
+DEPTH = 64
+
+pending = collections.deque(maxlen=64)
+positional = collections.deque([], 16)
+jobs = queue.Queue(maxsize=8)
+sized = queue.Queue(DEPTH)

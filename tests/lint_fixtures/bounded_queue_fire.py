@@ -1,0 +1,14 @@
+# lint-fixture: select=bounded-queue rel=stencil_tpu/serve/fake.py expect=bounded-queue,bounded-queue,bounded-queue,bad-suppression
+# Seeded violations: an unbounded deque, a default-unbounded queue.Queue,
+# and an explicit maxlen=None; a reasoned suppression silences a fourth
+# site; a bare suppression fails.
+import collections
+import queue
+
+pending = collections.deque()
+jobs = queue.Queue()
+ring = collections.deque([], None)
+# stencil-lint: disable=bounded-queue fixture: reasoned suppression silences the deque below
+scratch = collections.deque()
+ok = collections.deque(maxlen=64)  # bounded by construction: fine
+# stencil-lint: disable=bounded-queue

@@ -339,7 +339,7 @@ class TestRetry:
                 raise RuntimeError("UNAVAILABLE: connection reset by peer")
             return "ok"
 
-        policy = RetryPolicy(max_retries=3, backoff_base_s=0.1, multiplier=2.0)
+        policy = RetryPolicy(max_retries=3, backoff_base_s=0.1, multiplier=2.0, jitter=0.0)
         out = execute_with_retry(flaky, policy=policy, sleep=delays.append)
         assert out == "ok" and calls["n"] == 3
         assert delays == pytest.approx([0.1, 0.2])

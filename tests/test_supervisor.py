@@ -391,6 +391,51 @@ class TestElasticCapacity:
         assert "mesh 2x2x1" in rendered
         assert "mesh reshard" in rendered and "2x2x2 -> 2x2x1" in rendered
 
+    def test_coalesced_notices_one_drain_one_reshard(self, tmp_path):
+        """Three capacity signals land inside ONE chunk window — a seeded
+        grow notice, an operator SIGUSR1 refit, and a serving-policy
+        ``request_capacity`` shrink.  The pending-notice slot is last-
+        wins: the supervisor answers with exactly ONE drain-and-reshard
+        at the next boundary, onto the LAST requested target — never
+        three back-to-back transitions."""
+        import signal as _signal
+
+        want = _model(12).temperature()
+        m = _model()
+        sup = self._sup(tmp_path, m)
+        # signal 1: the seeded grow notice fires at dispatch 3 (a no-op
+        # target on the full fleet — overwritten before the boundary)
+        inject.set_plan("dispatch:grow:jacobi@2")
+        calls = [0]
+
+        def advance(n):
+            calls[0] += 1
+            m.step(n)
+            if calls[0] == 3:
+                # signal 2: the operator's SIGUSR1 refit, same window;
+                # wait for the (main-thread) handler so ordering is pinned
+                os.kill(os.getpid(), _signal.SIGUSR1)
+                deadline = time.time() + 5.0
+                while sup._capacity_request != "refit" and time.time() < deadline:
+                    time.sleep(0.001)
+                assert sup._capacity_request == "refit"
+                # signal 3: the elasticity policy's shrink — the last word
+                sup.request_capacity("shrink", source="policy")
+
+        out = sup.run(12, advance=advance, chunk=1)
+        assert out.completed and out.restarts == 0
+        # ONE coalesced transition, onto the last-wins shrink target
+        assert [t["kind"] for t in sup.mesh_history] == ["reshard"]
+        assert sup.mesh_history[0]["source"] == "shrink"
+        assert m.dd.mesh_dim() == (2, 2, 1)
+        np.testing.assert_array_equal(m.temperature(), want)
+
+    def test_request_capacity_validates_kind(self, tmp_path):
+        m = _model()
+        sup = self._sup(tmp_path, m)
+        with pytest.raises(ValueError, match="grow/shrink/refit"):
+            sup.request_capacity("explode")
+
 
 class TestRestartBudgetReplenish:
     """STENCIL_RESTART_WINDOW: sustained healthy progress restores spent

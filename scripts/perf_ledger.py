@@ -11,8 +11,13 @@ Thin shim over ``stencil_tpu/telemetry/ledger.py`` (jax-free):
     # (the gate flags rises there, not drops); serve_summary.json serving
     # artifacts (bin/stencil_serve.py, run_soak.py --serve) land as the
     # LOWER-is-better `serve:p99_ms` / `serve:shed_rate` SLO series, and
-    # only when their tenant-isolation verdict held
-    python scripts/perf_ledger.py ingest BENCH_*.json weak_scaling_out/weak_scaling_summary.json exchange_ab.json soak_out/soak_summary.json serve_out/serve_summary.json
+    # only when their tenant-isolation verdict held; fabric-probe artifacts
+    # (telemetry/fabric.py, `python -m stencil_tpu.fabric --out`) land as
+    # the per-direction `fabric:link_gbps[:axis.side]` series; perf_report
+    # --json comms-roofline reports land as the measured `exchange_hop:*`
+    # per-hop series (weak-scaling meshes also carry analytic
+    # LOWER-is-better `exchange_hop:<mesh>:*:bytes` rows)
+    python scripts/perf_ledger.py ingest BENCH_*.json weak_scaling_out/weak_scaling_summary.json exchange_ab.json soak_out/soak_summary.json serve_out/serve_summary.json fabric.json comms_roofline.json
 
     # the regression gate: newest value per series vs its trailing median
     python scripts/perf_ledger.py check --threshold 0.1 --window 5

@@ -19,10 +19,21 @@ set and — for device truth — ``--profile-dir`` pointing inside it):
   device truth; and with ``--merge``, the file the device rows are merged
   into so Perfetto shows both on one timeline.
 
+The report also grows a COMMS dimension when the trace carries device rows:
+collective-permute device time attributed per registered
+``exchange.<axis>.<side>`` scope, joined with the analytic
+``exchange.hop.*.bytes`` counters into achieved per-link GB/s — and, with
+``--fabric`` pointing at a probe artifact (``python -m stencil_tpu.fabric
+--out``), compared against the PROBED link bandwidth per mesh axis per
+direction, bottleneck axis named.  ``--json PATH`` writes that comms
+roofline as its own ``{"bench": "comms_roofline", ...}`` artifact —
+the shape ``perf_ledger.py`` ingests as ``exchange_hop:*`` series.
+
 Outputs: ``roofline.json`` + ``roofline.md`` in the telemetry dir (or
 ``--out-json`` / ``--out-md``).
 
-    python scripts/perf_report.py /tmp/telem --chip "TPU v5e" --merge
+    python scripts/perf_report.py /tmp/telem --chip "TPU v5e" --merge \\
+        --fabric fabric.json --json comms_roofline.json
 """
 
 from __future__ import annotations
@@ -75,6 +86,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="also merge the device rows into DIR's host Chrome trace "
         "(trace_*.json) so Perfetto shows one timeline",
     )
+    p.add_argument(
+        "--fabric",
+        default=None,
+        metavar="PATH",
+        help="fabric probe artifact (telemetry/fabric.py; `python -m "
+        "stencil_tpu.fabric --out`) — joins probed per-link ceilings into "
+        "the comms roofline",
+    )
+    p.add_argument(
+        "--json",
+        default=None,
+        metavar="PATH",
+        dest="comms_json",
+        help="also write the machine-readable comms-roofline report "
+        '({"bench": "comms_roofline", ...}) to PATH — the shape '
+        "perf_ledger.py ingests as exchange_hop:* series",
+    )
     p.add_argument("--out-json", default=None, metavar="PATH")
     p.add_argument("--out-md", default=None, metavar="PATH")
     return p
@@ -107,11 +135,16 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from stencil_tpu.telemetry.device import (
         attribute_device_time,
+        attribute_exchange_directions,
         find_trace_files,
         load_trace_events,
         merge_device_rows,
     )
-    from stencil_tpu.telemetry.roofline import render_markdown, roofline_report
+    from stencil_tpu.telemetry.roofline import (
+        comms_roofline,
+        render_markdown,
+        roofline_report,
+    )
     from stencil_tpu.utils.artifact import atomic_write_json, atomic_write_text
 
     snapshot = _load_metrics(args)
@@ -122,7 +155,7 @@ def main(argv=None) -> int:
     # so the patterns are already disjoint; this is belt and braces)
     device_traces = [t for t in find_trace_files(profile_dir) if t not in host_traces]
 
-    attribution, source = None, "device"
+    attribution, source, directions = None, "device", None
     if device_traces:
         events = load_trace_events(device_traces[0])
         if events:
@@ -132,6 +165,9 @@ def main(argv=None) -> int:
                 # frames only) is not device truth — fall through to host
                 attribution = None
         if attribution is not None:
+            # per-direction exchange attribution (device rows only: a
+            # host-only dump attributes zero, never wall-clock garbage)
+            directions = attribute_exchange_directions(events)
             if args.merge and host_traces:
                 with open(host_traces[0], encoding="utf-8") as f:
                     doc = json.load(f)
@@ -158,6 +194,29 @@ def main(argv=None) -> int:
         measured_hbm_gbps=args.hbm_gbps,
         source=source,
     )
+
+    fabric_model = None
+    if args.fabric:
+        from stencil_tpu.telemetry.fabric import link_model
+
+        with open(args.fabric, encoding="utf-8") as f:
+            fabric_model = link_model(json.load(f))
+    comms = comms_roofline(directions, snapshot, fabric_model)
+    if comms is not None:
+        report["comms"] = comms
+    if args.comms_json:
+        atomic_write_json(
+            args.comms_json,
+            {
+                "bench": "comms_roofline",
+                "chip": args.chip,
+                "source": source,
+                **(comms or {"coverage": None, "hops": {},
+                             "bottleneck": None, "bottleneck_axis": None}),
+            },
+        )
+        print(f"wrote comms roofline to {args.comms_json}", file=sys.stderr)
+
     out_json = args.out_json or os.path.join(args.dir, "roofline.json")
     out_md = args.out_md or os.path.join(args.dir, "roofline.md")
     atomic_write_json(out_json, report)

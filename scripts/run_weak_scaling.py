@@ -89,6 +89,14 @@ def build_parser() -> argparse.ArgumentParser:
         "and stream-plan (incl. overlap) axes first (cached per workload)",
     )
     p.add_argument(
+        "--fabric-probe",
+        action="store_true",
+        help="pass --fabric-probe through: each mesh probes (or warm-loads "
+        "from STENCIL_FABRIC_CACHE) its fabric link matrix and embeds the "
+        "summary in the per-mesh artifact; the sweep heartbeat renders it "
+        "(`python -m stencil_tpu.status <out-dir>`)",
+    )
+    p.add_argument(
         "--out-dir",
         default="weak_scaling_out",
         metavar="DIR",
@@ -128,6 +136,8 @@ def run_mesh(mesh, args, out_path: str) -> dict | None:
         cmd += ["--exchange-route", args.exchange_route]
     if args.tune:
         cmd.append("--tune")
+    if args.fabric_probe:
+        cmd.append("--fabric-probe")
     env = dict(os.environ)
     if args.dryrun:
         n = mx * my * mz
@@ -200,6 +210,10 @@ def main(argv=None) -> int:
         )
         doc = run_mesh(mesh, args, out_path)
         results.append(doc)
+        if doc.get("fabric"):
+            # sticky heartbeat state: the newest mesh's probed link model —
+            # status.py renders the matrix + slowest-link callout
+            flight.state["fabric"] = doc["fabric"]
 
     if not results:
         flight.heartbeat(0, len(meshes), phase="failed", stage="no mesh ran")
@@ -227,6 +241,10 @@ def main(argv=None) -> int:
                     ov: per_chip(doc, ov) for ov in ("off", "split")
                 },
                 "exchange_ms": doc["exchange"]["ms_per_exchange"],
+                # the per-hop attribution table (bin/weak.py _hop_table):
+                # analytic bytes + apportioned ms per mesh hop — the rows
+                # perf_ledger.py gates as exchange_hop:<mesh>:* series
+                "exchange_hops": doc["exchange"].get("hops") or [],
                 "split_speedup": doc["split_speedup"],
                 "weak_efficiency": {
                     ov: (

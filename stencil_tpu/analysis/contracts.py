@@ -674,9 +674,12 @@ class VmemBudget(Contract):
 class SpanRegistry(Contract):
     name = "span-registry"
     why = (
-        "every dotted named-scope label in the traced program is a "
-        "registered span (telemetry/names.py ALL_SPANS) — an unregistered "
-        "scope silently falls out of device-time attribution"
+        "every named-scope label in the traced program is a registered span "
+        "(telemetry/names.py ALL_SPANS) — an unregistered scope silently "
+        "falls out of device-time attribution.  The exchange sweeps' "
+        "per-direction scopes (exchange.<axis>.<side>) are covered too: "
+        "the undotted-local-marker escape hatch is gone now that every "
+        "in-kernel scope comes from the registry"
     )
 
     def check(self, art: ProgramArtifact) -> List[Finding]:
@@ -685,10 +688,7 @@ class SpanRegistry(Contract):
 
         out: List[Finding] = []
         for label in sorted(jx.scope_labels(art.closed)):
-            # dotted labels are telemetry-shaped (<subsystem>.<noun>...);
-            # undotted scopes (halo_ppermute_z_from_low) are local markers
-            # outside the attribution join
-            if "." in label and label not in tm.ALL_SPANS:
+            if label not in tm.ALL_SPANS:
                 out.append(
                     art.finding(
                         self.name,

@@ -164,6 +164,28 @@ def overlap_domain_size(args, mesh, devices, weak_scale: bool):
     return _common.fit_to_mesh(args.x, args.y, args.z, radius, devices=devices)
 
 
+def _hop_table(dd, s_exch: float) -> list:
+    """The per-hop attribution table every per-mesh artifact carries: the
+    ANALYTIC decomposition of the exchange bytes over each mesh hop
+    (``DistributedDomain.exchange_hop_bytes``; hops on unsplit axes report
+    0), with the measured per-exchange time apportioned by byte share.
+    Tagged ``source: "analytic"`` — a profiler trace upgrades these to
+    measured per-direction device time (``scripts/perf_report.py``)."""
+    hop_bytes = dd.exchange_hop_bytes()
+    total = sum(hop_bytes.values())
+    return [
+        {
+            "axis": axis,
+            "side": side,
+            "bytes": nb,
+            "share_of_bytes": round(nb / total, 4) if total else None,
+            "est_ms": round(s_exch * 1e3 * nb / total, 6) if total else None,
+            "source": "analytic",
+        }
+        for (axis, side), nb in sorted(hop_bytes.items())
+    ]
+
+
 def run_overlap(args, name: str = "weak", weak_scale: bool = True) -> dict:
     """The stream-engine overlap A/B at this mesh: build ``overlap=off`` and
     ``overlap=split`` steps over ONE realized domain (non-donating, the
@@ -301,6 +323,19 @@ def run_overlap(args, name: str = "weak", weak_scale: bool = True) -> dict:
     )
     s_off, s_split, s_exch = (statistics.median(r) for r in rounds)
 
+    fabric_summary = None
+    if getattr(args, "fabric_probe", False):
+        # after the measured rounds: the probe's own dispatches must not
+        # land inside the A/B timing.  Warm cache (same topology/chip/
+        # payload under STENCIL_FABRIC_CACHE) = zero device work here.
+        from stencil_tpu.telemetry import fabric as _fabric
+
+        fdoc = _fabric.ensure(
+            dd.mesh,
+            nbytes=(1 << 16) if interpret else _fabric.DEFAULT_NBYTES,
+        )
+        fabric_summary = _fabric.summary(fdoc)
+
     cells = x * y * z
     dim = dd.placement.dim()
     doc = {
@@ -344,8 +379,11 @@ def run_overlap(args, name: str = "weak", weak_scale: bool = True) -> dict:
             "s_per_exchange": s_exch,
             "ms_per_exchange": s_exch * 1e3,
             "bytes_per_exchange": dd.exchange_bytes_total(),
+            "hops": _hop_table(dd, s_exch),
         },
     }
+    if fabric_summary is not None:
+        doc["fabric"] = fabric_summary
     if contracts_verified is not None:
         doc["contracts_verified"] = contracts_verified
     if tune_section is not None:
@@ -412,6 +450,14 @@ def build_parser(name: str, overlap_flags: bool = True) -> argparse.ArgumentPars
         metavar="N",
         help="steady-state reps for the overlap A/B (alternating protocol, "
         "rep 0 dropped, median)",
+    )
+    p.add_argument(
+        "--fabric-probe",
+        action="store_true",
+        help="with --overlap: probe (or warm-load from STENCIL_FABRIC_CACHE) "
+        "the per-link fabric matrix for this mesh and embed its summary in "
+        "the artifact (telemetry/fabric.py; docs/observability.md 'Fabric "
+        "observatory')",
     )
     p.add_argument(
         "--verify",

@@ -219,8 +219,10 @@ class DistributedDomain:
         self._watchdog = None
         self._watchdog_resolved = False
         # analytic bytes per exchange (exchange_bytes_total), computed once
-        # per realize() for the telemetry counters
+        # per realize() for the telemetry counters; the per-hop decomposition
+        # (exchange_hop_bytes) is cached beside it as (counter, bytes) pairs
         self._exchange_nbytes: Optional[int] = None
+        self._hop_nbytes: Optional[List[Tuple[str, int]]] = None
 
     def set_watchdog(self, wd) -> None:
         """Install (or clear, with ``None``) a dispatch watchdog
@@ -745,6 +747,7 @@ class DistributedDomain:
         # analytic byte models recompute lazily
         self._exchange_many_fn = None
         self._exchange_nbytes = None
+        self._hop_nbytes = None
         self._packed_nbytes = self._packed_nkernels = 0
         self._shell_stale = False
         if self._numerics is not None:
@@ -795,6 +798,7 @@ class DistributedDomain:
         self._exchange_fn = None
         self._exchange_many_fn = None
         self._exchange_nbytes = None
+        self._hop_nbytes = None
         self._packed_nbytes = self._packed_nkernels = 0
         self._shell_stale = False
         if self._numerics is not None:
@@ -1181,6 +1185,16 @@ class DistributedDomain:
             telemetry.set_gauge(
                 tm.EXCHANGE_BYTES_PER_EXCHANGE, self._exchange_nbytes
             )
+            # per-hop decomposition for the comms roofline: modeled once,
+            # then the hot path is one inc per TRAFFICKED hop (size-1 mesh
+            # axes are dropped here — their counters stay seeded at 0)
+            self._hop_nbytes = [
+                (tm.EXCHANGE_HOP_BYTES[(axis, side)], nb)
+                for (axis, side), nb in sorted(
+                    self.exchange_hop_bytes().items()
+                )
+                if nb
+            ] if self._handles else []
             if self._handles and self._exchange_route != "direct":
                 # analytic packed-route traffic (like the bytes model above:
                 # modeled once, an int multiply on the hot path).  Each
@@ -1228,6 +1242,8 @@ class DistributedDomain:
                 self._packed_nkernels = kernels * self.num_subdomains()
         telemetry.inc(tm.EXCHANGE_COUNT, n)
         telemetry.inc(tm.EXCHANGE_BYTES, n * self._exchange_nbytes)
+        for counter, nb in self._hop_nbytes:
+            telemetry.inc(counter, n * nb)
         if self._packed_nkernels:
             telemetry.inc(tm.EXCHANGE_PACKED_BYTES, n * self._packed_nbytes)
             telemetry.inc(tm.EXCHANGE_PACKED_KERNELS, n * self._packed_nkernels)
@@ -1312,6 +1328,34 @@ class DistributedDomain:
             ],
         )
         return per_dom * self.num_subdomains()
+
+    def exchange_hop_bytes(self) -> Dict[Tuple[str, str], int]:
+        """Analytic bytes-per-exchange over each mesh hop, keyed
+        ``(mesh axis name, side)`` with side in ``low``/``high`` — the
+        per-direction decomposition of the sweep traffic
+        (core/geometry.py ``sweep_hop_bytes``) summed across subdomains.
+        Hops on mesh axes of size 1 report 0: their ppermute self-wraps
+        (the periodic boundary inside one chip), so no fabric traffic.
+        Feeds the ``exchange.hop.*.bytes`` counters and the per-hop table
+        in the weak-scaling artifacts (docs/observability.md "Fabric
+        observatory")."""
+        from stencil_tpu.core.geometry import sweep_hop_bytes
+
+        per_dom = sweep_hop_bytes(
+            self._spec,
+            [
+                self.field_dtype(h).itemsize * h.cell_count()
+                for h in self._handles
+            ],
+        )
+        n_sub = self.num_subdomains()
+        shape = dict(self.mesh.shape) if self.mesh is not None else {}
+        return {
+            (MESH_AXES[axis], side): (
+                nb * n_sub if shape.get(MESH_AXES[axis], 1) > 1 else 0
+            )
+            for (axis, side), nb in per_dom.items()
+        }
 
     def write_plan(self, prefix: str = "plan", link_model=None) -> str:
         """Dump the communication plan — the analog of the reference's
@@ -1541,7 +1585,7 @@ class DistributedDomain:
             if overlap:
                 # interior: no shell reads -> no ppermute dependency; XLA
                 # schedules it concurrently with the collective
-                with jax.named_scope("interior_compute"):
+                with jax.named_scope(tm.SPAN_OVERLAP_INTERIOR):
                     int_region = rect_to_slices(interior_rect)
                     int_vals = region_update(blocks, int_region, origin)
             # joint multi-quantity exchange: all fields fuse into one message

@@ -13,6 +13,13 @@ attribution (telemetry/device.py):
 2. A span call naming a COUNTER or EVENT constant parses as "registered"
    under ``telemetry-name`` but forks the timeline kind: span literals
    must be spans specifically.
+3. ``jax.named_scope(<string literal>)`` — the raw form the in-kernel
+   exchange sweeps once used (``halo_ppermute_*`` f-strings).  Kernel
+   scopes are device-timeline spans exactly like ``annotate`` labels, so
+   a literal there must be a registered span too; non-literal arguments
+   (the ``names.exchange_direction_span`` helper, SPAN_* constants) are
+   the sanctioned form and pass through — the ``span-registry`` contract
+   covers those at trace level.
 
 Scope: the product tree (``stencil_tpu/``) and ``bench.py`` — telemetry
 internals are exempt (they pass names through as parameters), and tests
@@ -42,16 +49,17 @@ def _span_registry():
 
 
 def _is_span_call(node: ast.Call) -> bool:
-    """``telemetry.annotate/span/record_span(...)`` or a bare
-    ``annotate(...)`` (the one verb distinctive enough to match by name —
-    plain ``span`` collides with too many locals)."""
+    """``telemetry.annotate/span/record_span(...)``, a bare ``annotate(...)``
+    (the one verb distinctive enough to match by name — plain ``span``
+    collides with too many locals), or ``jax.named_scope(...)`` (in-kernel
+    device-timeline scopes)."""
     f = node.func
     if isinstance(f, ast.Attribute):
-        return (
-            isinstance(f.value, ast.Name)
-            and f.value.id in FACADE_ALIASES
-            and f.attr in SPAN_TAKING_CALLS
-        )
+        if not isinstance(f.value, ast.Name):
+            return False
+        if f.value.id in FACADE_ALIASES and f.attr in SPAN_TAKING_CALLS:
+            return True
+        return f.value.id == "jax" and f.attr == "named_scope"
     if isinstance(f, ast.Name):
         return f.id == "annotate"
     return False

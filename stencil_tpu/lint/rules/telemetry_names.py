@@ -45,12 +45,16 @@ NAMES_ALIASES = {"names", "tm"}
 
 def _registry():
     """names.ALL_NAMES plus the constant map — imported lazily so the lint
-    package stays importable even mid-refactor of the telemetry package."""
+    package stays importable even mid-refactor of the telemetry package.
+
+    ``constants`` holds every uppercase module attribute: plain string names
+    AND the keyed registries over them (``EXCHANGE_HOP_BYTES``,
+    ``EXCHANGE_DIRECTION_SPANS`` — dicts mapping (axis, side) to a
+    registered name).  The existence check accepts both; the hygiene checks
+    in ``finalize`` apply only to the string-valued ones."""
     from stencil_tpu.telemetry import names
 
-    constants = {
-        k: v for k, v in vars(names).items() if k.isupper() and isinstance(v, str)
-    }
+    constants = {k: v for k, v in vars(names).items() if k.isupper()}
     return names.ALL_NAMES, constants
 
 
@@ -137,6 +141,8 @@ class TelemetryNameRule(Rule):
         seen = {}
         rel = "stencil_tpu/telemetry/names.py"
         for const, value in sorted(constants.items()):
+            if not isinstance(value, str):
+                continue  # keyed registries: their values are the constants
             if not all(part for part in value.split(".")) or value != value.lower():
                 out.append(
                     Violation(

@@ -57,6 +57,7 @@ from stencil_tpu.core.dim3 import Dim3
 from stencil_tpu.utils.compat import shard_map
 from stencil_tpu.core.radius import Radius
 from stencil_tpu.parallel.mesh import MESH_AXES
+from stencil_tpu.telemetry import names as tm
 
 #: exchange implementations for the y/z axis sweeps — a first-class tuner
 #: axis (tune/space.py ``exchange_space``; docs/tuning.md "Exchange
@@ -179,13 +180,15 @@ def ypack_message_stats(raw_spatial, r_lo: int, r_hi: int, itemsizes) -> Tuple[i
 
 def _shift_from_low(x, axis_name: str, n: int):
     """Each shard receives the value held by its -1 neighbor (data moves +)."""
-    with jax.named_scope(f"halo_ppermute_{axis_name}_from_low"):  # NVTX analog
+    # NVTX analog: a REGISTERED per-direction scope (names.ALL_SPANS), so
+    # profiler traces attribute this ppermute's device time to its mesh hop
+    with jax.named_scope(tm.exchange_direction_span(axis_name, "low")):
         return lax.ppermute(x, axis_name, [(k, (k + 1) % n) for k in range(n)])
 
 
 def _shift_from_high(x, axis_name: str, n: int):
     """Each shard receives the value held by its +1 neighbor (data moves -)."""
-    with jax.named_scope(f"halo_ppermute_{axis_name}_from_high"):
+    with jax.named_scope(tm.exchange_direction_span(axis_name, "high")):
         return lax.ppermute(x, axis_name, [(k, (k - 1) % n) for k in range(n)])
 
 
@@ -645,7 +648,7 @@ def fused_shell_exchange(
     compute identical level-0 planes.
 
     Structure: one ``_fused_shift`` per direction — the same ≤6-permute,
-    one-message-per-direction shape (and the same ``halo_ppermute_*``
+    one-message-per-direction shape (and the same ``exchange.<axis>.<side>``
     scopes) the ``exchange-structure`` contract pins on every route.
     """
     from stencil_tpu.ops.pack import (

@@ -102,6 +102,42 @@ def _tenant_lines(rows, indent: str = "  ") -> list:
     return lines
 
 
+def _fabric_lines(doc, indent: str = "  ") -> list:
+    """The fabric observatory's heartbeat state (``telemetry/fabric.summary``
+    shape): per-axis median link bandwidth, the slowest-link callout, and
+    the per-neighbor matrix (rows = sending flat device index)."""
+    if not isinstance(doc, dict):
+        return []
+    topo = "x".join(str(v) for v in (doc.get("topology") or [])) or "?"
+    lines = [f"{indent}fabric (topology {topo}, {doc.get('chip', '?')}):"]
+    for axis, sides in sorted((doc.get("axes") or {}).items()):
+        if not isinstance(sides, dict):
+            continue
+        per = ", ".join(
+            f"{side} {_fmt_stat(sides.get(side))} GB/s"
+            for side in ("low", "high")
+            if side in sides
+        )
+        lines.append(f"{indent}  axis {axis}: {per}")
+    slow = doc.get("slowest")
+    if isinstance(slow, dict):
+        lines.append(
+            f"{indent}  slowest link: {slow.get('axis')}.{slow.get('side')} "
+            f"{slow.get('src')}->{slow.get('dst')} at "
+            f"{_fmt_stat(slow.get('gbps'))} GB/s"
+        )
+    matrix = doc.get("matrix")
+    if isinstance(matrix, list) and matrix and len(matrix) <= 16:
+        lines.append(f"{indent}  link matrix (GB/s):")
+        for row in matrix:
+            cells = " ".join(
+                f"{v:7.2f}" if isinstance(v, (int, float)) and v else "      ."
+                for v in row
+            )
+            lines.append(f"{indent}    {cells}")
+    return lines
+
+
 def render(status, crash, stale_after: float = 300.0) -> str:
     """The human view of one run directory's flight state."""
     lines = []
@@ -161,6 +197,9 @@ def render(status, crash, stale_after: float = 300.0) -> str:
         lines.extend(_numerics_lines(status.get("numerics")))
         # serving heartbeats carry the per-tenant table (docs/serving.md)
         lines.extend(_tenant_lines(status.get("tenants")))
+        # fabric observatory: the probed link model the run started under
+        # (docs/observability.md "Fabric observatory")
+        lines.extend(_fabric_lines(status.get("fabric")))
         if status.get("last_error"):
             lines.append(f"  last error: {status['last_error']}")
     if crash is not None:

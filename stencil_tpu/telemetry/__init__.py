@@ -184,8 +184,13 @@ def observe(name: str, value: float) -> None:
 
 def snapshot() -> dict:
     """JSON-safe dict of all metrics.  Every canonical counter name appears
-    (0 when untouched) so snapshots diff cleanly across rounds."""
-    return _cfg().registry.snapshot(seed_counters=names.ALL_COUNTERS)
+    (0 when untouched) and every canonical histogram appears (empty
+    distribution when never observed) so snapshots diff cleanly across
+    rounds."""
+    return _cfg().registry.snapshot(
+        seed_counters=names.ALL_COUNTERS,
+        seed_histograms=names.ALL_HISTOGRAMS,
+    )
 
 
 # --- spans -------------------------------------------------------------------

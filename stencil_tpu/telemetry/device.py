@@ -72,6 +72,14 @@ PHASE_PATTERNS: Dict[str, Tuple[str, ...]] = {
     "step": (names.SPAN_STEP,),
 }
 
+#: one phase per registered exchange direction scope (``exchange.x.low``
+#: ...) — the per-hop VIEW of the exchange family for the comms roofline;
+#: the kernel sweeps enter these scopes around every ppermute
+#: (ops/exchange.py ``_shift_from_low``/``_shift_from_high``)
+EXCHANGE_DIRECTION_PHASES: Dict[str, Tuple[str, ...]] = {
+    span: (span,) for span in sorted(names.EXCHANGE_DIRECTION_SPANS.values())
+}
+
 #: process-name patterns that mark a trace pid as a DEVICE row source
 _DEVICE_PROCESS_RE = re.compile(
     r"/device:|TPU|GPU|XLA|Device|Chip", re.IGNORECASE
@@ -90,7 +98,7 @@ CAPTURE_COUNTERS = (
     names.EXCHANGE_BYTES,
     names.EXCHANGE_PACKED_BYTES,
     names.KERNEL_MXU_FLOPS,
-)
+) + tuple(sorted(names.EXCHANGE_HOP_BYTES.values()))
 
 
 # --- locating and loading trace dumps ----------------------------------------
@@ -199,6 +207,41 @@ def attribute_device_time(
             out["_unattributed"]["device_us"] += dur
             out["_unattributed"]["events"] += 1
     return out
+
+
+def attribute_exchange_directions(events: List[dict]) -> dict:
+    """Collective-permute device time per exchange DIRECTION — the per-hop
+    half of the comms roofline join.
+
+    Runs ``attribute_device_time`` with one phase per registered
+    ``exchange.<axis>.<side>`` scope plus the whole exchange family, and
+    returns::
+
+        {"directions": {span: {"device_us", "events"}},   # all six, zeros kept
+         "exchange_device_us": float,   # the exchange-family total
+         "attributed_us": float,        # summed direction time
+         "coverage": float | None,      # attributed / exchange; None when no
+                                        # exchange device time was seen
+         "total_device_us": float}
+
+    Direction rows are disjoint views (one scope path per trace row), so
+    ``attributed_us`` is additive and ``coverage`` is the honest "how much
+    of the exchange landed on a named hop" figure the fixture test pins at
+    >=90%.  Host-only dumps inherit ``attribute_device_time``'s zero
+    behavior: everything 0, coverage None — never wall-clock garbage."""
+    phases = dict(EXCHANGE_DIRECTION_PHASES)
+    phases["exchange"] = PHASE_PATTERNS["exchange"]
+    att = attribute_device_time(events, phases)
+    directions = {span: att[span] for span in EXCHANGE_DIRECTION_PHASES}
+    exchange_us = att["exchange"]["device_us"]
+    attributed_us = sum(d["device_us"] for d in directions.values())
+    return {
+        "directions": directions,
+        "exchange_device_us": exchange_us,
+        "attributed_us": attributed_us,
+        "coverage": (attributed_us / exchange_us) if exchange_us > 0 else None,
+        "total_device_us": att["_total"]["device_us"],
+    }
 
 
 # --- merging device rows into the host Chrome trace --------------------------

@@ -68,6 +68,11 @@ class FlightRecorder:
         self.label = label
         self._window = collections.deque(maxlen=_RATE_WINDOW)
         self._last_status: dict = {}
+        #: sticky extras merged under EVERY heartbeat (per-beat ``state``
+        #: wins on key collisions) — run-scoped facts a caller establishes
+        #: once, like the probed fabric link model (``status.py`` renders
+        #: a ``fabric`` key as the matrix + slowest-link callout)
+        self.state: dict = {}
 
     @property
     def status_path(self) -> str:
@@ -114,6 +119,7 @@ class FlightRecorder:
             "total_steps": total_steps,
             "rate_steps_per_s": self._rate(int(step)),
         }
+        doc.update(self.state)
         doc.update(state)
         self._last_status = doc
         try:

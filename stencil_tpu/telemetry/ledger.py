@@ -10,7 +10,8 @@ normalizes every artifact into one-line entries
      workload label when the artifact carries one>, ...}
 
 keyed by series (the headline metric, the exchange-path and astaroth
-companions, each weak-scaling mesh/overlap cell), deduped on
+companions, each weak-scaling mesh/overlap cell, the fabric observatory's
+``fabric:link_gbps`` and per-hop ``exchange_hop:*`` series), deduped on
 ``(key, source, ts)`` so re-ingesting the same file is idempotent while
 regenerated artifacts and fresh live runs grow their series.  Appends go through
 append-mode writes — one complete JSON document per line, the same crash
@@ -155,6 +156,70 @@ def entries_from_artifact(path: str) -> List[dict]:
                     _entry(ts, f"weak:{mesh}:{ov}", val, "Mcells/s/chip",
                            source, chips=m.get("chips"))
                 )
+            # the per-hop attribution table (analytic bytes per mesh hop,
+            # bin/weak.py): LOWER-is-better — a rise means the halo traffic
+            # over that link GREW (a decomposition/packing regression)
+            for hop in m.get("exchange_hops") or []:
+                out.append(
+                    _entry(
+                        ts,
+                        f"exchange_hop:{mesh}:{hop.get('axis')}."
+                        f"{hop.get('side')}:bytes",
+                        hop.get("bytes"),
+                        "B",
+                        source,
+                        better="lower",
+                        hop_source=hop.get("source"),
+                    )
+                )
+        return [e for e in out if e is not None]
+
+    if isinstance(doc, dict) and doc.get("bench") == "fabric_probe":
+        # the fabric observatory's probed link model (telemetry/fabric.py):
+        # per-axis/per-direction median link bandwidth plus the slowest-link
+        # headline — higher-is-better, so the gate catches a link (cable,
+        # routing, throttle) that got slower between rounds
+        from stencil_tpu.telemetry.fabric import link_model
+
+        model = link_model(doc)
+        for axis, sides in sorted(model.get("axes", {}).items()):
+            for side, s in sorted(sides.items()):
+                out.append(
+                    _entry(
+                        ts, f"fabric:link_gbps:{axis}.{side}", s.get("gbps_med"),
+                        "GB/s", source, links=s.get("links"),
+                        chip=doc.get("chip"),
+                    )
+                )
+        slow = model.get("slowest") or {}
+        out.append(
+            _entry(
+                ts, "fabric:link_gbps", slow.get("gbps"), "GB/s", source,
+                axis=slow.get("axis"), side=slow.get("side"),
+                chip=doc.get("chip"),
+            )
+        )
+        return [e for e in out if e is not None]
+
+    if isinstance(doc, dict) and doc.get("bench") == "comms_roofline":
+        # perf_report.py --json: measured per-hop exchange rates from the
+        # trace join — higher-is-better achieved GB/s per direction, plus
+        # the direction-attribution coverage (a drop there means exchange
+        # device time stopped landing on registered scopes)
+        for span, hop in sorted((doc.get("hops") or {}).items()):
+            out.append(
+                _entry(
+                    ts,
+                    f"exchange_hop:{hop.get('axis')}.{hop.get('direction')}:gbps",
+                    hop.get("gbps"), "GB/s", source,
+                    probed_gbps=hop.get("probed_gbps"),
+                    device_ms=hop.get("device_ms"),
+                )
+            )
+        out.append(
+            _entry(ts, "exchange_hop:coverage", doc.get("coverage"), "",
+                   source, bottleneck_axis=doc.get("bottleneck_axis"))
+        )
         return [e for e in out if e is not None]
 
     if isinstance(doc, dict) and doc.get("bench") == "soak_kill_resume":

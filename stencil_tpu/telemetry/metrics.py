@@ -124,18 +124,29 @@ class MetricsRegistry:
     def histogram(self, name: str) -> Histogram:
         return self._get(self._histograms, name, Histogram)
 
-    def snapshot(self, seed_counters: Iterable[str] = ()) -> dict:
+    def snapshot(
+        self,
+        seed_counters: Iterable[str] = (),
+        seed_histograms: Iterable[str] = (),
+    ) -> dict:
         """Plain-dict snapshot.  ``seed_counters`` names appear with value 0
-        even when never incremented, so the snapshot schema is stable across
-        rounds (a diff shows '0 -> 3 retries', not a key appearing)."""
+        even when never incremented, and ``seed_histograms`` names appear as
+        empty distributions (count 0, None stats), so the snapshot schema is
+        stable across rounds (a diff shows '0 -> 3 retries', not a key
+        appearing — and a cross-round diff of ``fabric.link.gbps`` never
+        KeyErrors on a registry that hasn't probed yet)."""
         counters = {name: 0 for name in sorted(seed_counters)}
         counters.update({c.name: c.value for c in self._counters.values()})
+        histograms = {
+            name: Histogram(name).snapshot() for name in sorted(seed_histograms)
+        }
+        histograms.update(
+            {h.name: h.snapshot() for h in sorted_values(self._histograms)}
+        )
         return {
             "counters": dict(sorted(counters.items())),
             "gauges": {g.name: g.value for g in sorted_values(self._gauges)},
-            "histograms": {
-                h.name: h.snapshot() for h in sorted_values(self._histograms)
-            },
+            "histograms": dict(sorted(histograms.items())),
         }
 
     def reset(self) -> None:

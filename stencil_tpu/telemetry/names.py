@@ -125,6 +125,36 @@ SERVE_SHED = "serve.shed"
 SERVE_EVICTED = "serve.evicted"
 #: requests served to completion
 SERVE_COMPLETED = "serve.completed"
+#: analytic bytes moved per exchange over ONE mesh hop — one counter per
+#: (axis, direction) so the comms roofline can price each link of the
+#: realized mesh (the per-direction decomposition of ``domain.exchange.bytes``
+#: — ``DistributedDomain.exchange_hop_bytes``); 0 on axes the mesh does not
+#: split
+EXCHANGE_HOP_X_LOW_BYTES = "exchange.hop.x.low.bytes"
+EXCHANGE_HOP_X_HIGH_BYTES = "exchange.hop.x.high.bytes"
+EXCHANGE_HOP_Y_LOW_BYTES = "exchange.hop.y.low.bytes"
+EXCHANGE_HOP_Y_HIGH_BYTES = "exchange.hop.y.high.bytes"
+EXCHANGE_HOP_Z_LOW_BYTES = "exchange.hop.z.low.bytes"
+EXCHANGE_HOP_Z_HIGH_BYTES = "exchange.hop.z.high.bytes"
+#: point-to-point fabric-probe transfers actually measured on device
+#: (telemetry/fabric.py — 0 when the probe answered from its warm cache)
+FABRIC_PROBE_RUNS = "fabric.probe.runs"
+#: fabric-probe cache consultations that found a persisted link matrix
+FABRIC_CACHE_HIT = "fabric.cache.hit"
+#: consultations that found nothing (cold cache, stale schema/toolchain,
+#: corrupt artifact) — mirrors the tune-cache miss semantics
+FABRIC_CACHE_MISS = "fabric.cache.miss"
+
+#: the per-hop byte counter for one (mesh axis, direction) — direction names
+#: follow the receive side: ``low`` receives from the -1 neighbor
+EXCHANGE_HOP_BYTES = {
+    ("x", "low"): EXCHANGE_HOP_X_LOW_BYTES,
+    ("x", "high"): EXCHANGE_HOP_X_HIGH_BYTES,
+    ("y", "low"): EXCHANGE_HOP_Y_LOW_BYTES,
+    ("y", "high"): EXCHANGE_HOP_Y_HIGH_BYTES,
+    ("z", "low"): EXCHANGE_HOP_Z_LOW_BYTES,
+    ("z", "high"): EXCHANGE_HOP_Z_HIGH_BYTES,
+}
 
 ALL_COUNTERS = frozenset({
     EXCHANGE_COUNT,
@@ -163,6 +193,15 @@ ALL_COUNTERS = frozenset({
     SERVE_SHED,
     SERVE_EVICTED,
     SERVE_COMPLETED,
+    EXCHANGE_HOP_X_LOW_BYTES,
+    EXCHANGE_HOP_X_HIGH_BYTES,
+    EXCHANGE_HOP_Y_LOW_BYTES,
+    EXCHANGE_HOP_Y_HIGH_BYTES,
+    EXCHANGE_HOP_Z_LOW_BYTES,
+    EXCHANGE_HOP_Z_HIGH_BYTES,
+    FABRIC_PROBE_RUNS,
+    FABRIC_CACHE_HIT,
+    FABRIC_CACHE_MISS,
 })
 
 # --- gauges (last-value) -----------------------------------------------------
@@ -218,6 +257,12 @@ SERVE_LATENCY_SECONDS = "serve.latency.seconds"
 #: wall seconds per AOT executable compile at admission (serve/aot.py —
 #: the cost the admission budget bounds)
 SERVE_COMPILE_SECONDS = "serve.compile.seconds"
+#: measured point-to-point link bandwidth over the realized mesh, GB/s per
+#: probed neighbor edge (telemetry/fabric.py — the NVML-distance-matrix
+#: analog feeding the comms roofline)
+FABRIC_LINK_GBPS = "fabric.link.gbps"
+#: wall seconds per fabric-probe sweep (warm-up + all measured rounds)
+FABRIC_PROBE_SECONDS = "fabric.probe.seconds"
 
 ALL_HISTOGRAMS = frozenset({
     STEP_SECONDS,
@@ -231,6 +276,8 @@ ALL_HISTOGRAMS = frozenset({
     NUMERICS_SNAPSHOT_SECONDS,
     SERVE_LATENCY_SECONDS,
     SERVE_COMPILE_SECONDS,
+    FABRIC_LINK_GBPS,
+    FABRIC_PROBE_SECONDS,
 })
 
 # --- spans (Chrome-trace timeline entries) -----------------------------------
@@ -249,6 +296,37 @@ SPAN_OVERLAP_EXTERIOR = "step.overlap.exterior"
 #: named scope entered around the per-round slice/permute/blend body, so
 #: device-time attribution can price a live mesh transition
 SPAN_RESHARD = "reshard.collective"
+#: the halo-exchange ppermutes, one DEVICE-timeline scope per (mesh axis,
+#: receive direction) — ops/exchange.py enters these around every
+#: ``lax.ppermute`` so profiler traces attribute collective-permute device
+#: time per link (``exchange.z.low`` receives the -1 z-neighbor's shell)
+SPAN_EXCHANGE_X_LOW = "exchange.x.low"
+SPAN_EXCHANGE_X_HIGH = "exchange.x.high"
+SPAN_EXCHANGE_Y_LOW = "exchange.y.low"
+SPAN_EXCHANGE_Y_HIGH = "exchange.y.high"
+SPAN_EXCHANGE_Z_LOW = "exchange.z.low"
+SPAN_EXCHANGE_Z_HIGH = "exchange.z.high"
+
+#: the direction span for one (mesh axis, receive side)
+EXCHANGE_DIRECTION_SPANS = {
+    ("x", "low"): SPAN_EXCHANGE_X_LOW,
+    ("x", "high"): SPAN_EXCHANGE_X_HIGH,
+    ("y", "low"): SPAN_EXCHANGE_Y_LOW,
+    ("y", "high"): SPAN_EXCHANGE_Y_HIGH,
+    ("z", "low"): SPAN_EXCHANGE_Z_LOW,
+    ("z", "high"): SPAN_EXCHANGE_Z_HIGH,
+}
+
+
+def exchange_direction_span(axis: str, side: str) -> str:
+    """The registered span name for one exchange hop (axis in x/y/z, side in
+    low/high).  In-kernel scopes must come through here (or the constants
+    above) so the span registry stays the single name authority."""
+    try:
+        return EXCHANGE_DIRECTION_SPANS[(axis, side)]
+    except KeyError:
+        raise ValueError(f"no exchange direction span for {axis!r}/{side!r}") from None
+
 
 ALL_SPANS = frozenset({
     SPAN_STEP,
@@ -257,6 +335,12 @@ ALL_SPANS = frozenset({
     SPAN_OVERLAP_INTERIOR,
     SPAN_OVERLAP_EXTERIOR,
     SPAN_RESHARD,
+    SPAN_EXCHANGE_X_LOW,
+    SPAN_EXCHANGE_X_HIGH,
+    SPAN_EXCHANGE_Y_LOW,
+    SPAN_EXCHANGE_Y_HIGH,
+    SPAN_EXCHANGE_Z_LOW,
+    SPAN_EXCHANGE_Z_HIGH,
 })
 
 # --- structured events (JSONL sink) ------------------------------------------
@@ -348,6 +432,9 @@ EVENT_SERVE_EVICTION = "serve.eviction"
 #: the load policy asked for capacity (fields: kind=grow|shrink,
 #: queue_depth, source)
 EVENT_SERVE_ELASTICITY = "serve.elasticity"
+#: a fabric-probe sweep resolved its link matrix (fields: source=cache|probe,
+#: topology, chip, edges, seconds, slowest_gbps — telemetry/fabric.py)
+EVENT_FABRIC_PROBE = "fabric.probe"
 
 ALL_EVENTS = frozenset({
     EVENT_COMPILE,
@@ -378,6 +465,7 @@ ALL_EVENTS = frozenset({
     EVENT_SERVE_SHED,
     EVENT_SERVE_EVICTION,
     EVENT_SERVE_ELASTICITY,
+    EVENT_FABRIC_PROBE,
     NUMERICS_DRIFT,
 })
 

@@ -186,6 +186,78 @@ def capture_report(
     )
 
 
+def comms_roofline(
+    direction_attribution: Optional[dict],
+    snapshot: Optional[dict],
+    fabric_model: Optional[dict] = None,
+) -> Optional[dict]:
+    """The communication dimension of the roofline: achieved per-link GB/s
+    per mesh axis per direction, vs the PROBED link bandwidth when a fabric
+    matrix is joined in.
+
+    Three inputs, all artifacts this repo already produces:
+
+    * ``direction_attribution`` — ``device.attribute_exchange_directions``
+      over a profiler trace: collective-permute device time per registered
+      ``exchange.<axis>.<side>`` scope, plus the coverage fraction of the
+      whole exchange family;
+    * ``snapshot`` — the analytic ``exchange.hop.<axis>.<side>.bytes``
+      counters (``DistributedDomain`` decomposes ``domain.exchange.bytes``
+      per hop);
+    * ``fabric_model`` — ``telemetry.fabric.link_model`` output (optional:
+      without it, achieved rates report with null probed ceilings).
+
+    The bottleneck is the direction with the most device time — the hop a
+    topology/placement change must shrink first.  Returns None when there
+    is no attribution at all (no trace).
+    """
+    if not direction_attribution:
+        return None
+    counters = _counters(snapshot)
+    axes_model = (fabric_model or {}).get("axes", {})
+    span_to_hop = {
+        span: hop for hop, span in names.EXCHANGE_DIRECTION_SPANS.items()
+    }
+    hops = {}
+    bottleneck = None
+    for span, row in (direction_attribution.get("directions") or {}).items():
+        axis, side = span_to_hop[span]
+        us = float(row.get("device_us", 0.0))
+        s = us / 1e6
+        b = counters.get(names.EXCHANGE_HOP_BYTES[(axis, side)])
+        probed = (axes_model.get(axis, {}).get(side) or {}).get("gbps_med")
+        entry = {
+            "axis": axis,
+            "direction": side,
+            "device_ms": round(us / 1e3, 6),
+            "events": int(row.get("events", 0)),
+            "bytes": int(b) if b else None,
+            "gbps": round(b / s / 1e9, 3) if (b and s > 0) else None,
+            "probed_gbps": probed,
+            "frac_of_link": None,
+        }
+        if entry["gbps"] is not None and probed:
+            entry["frac_of_link"] = round(entry["gbps"] / probed, 4)
+        hops[span] = entry
+        if us > 0 and (bottleneck is None or us > bottleneck["_us"]):
+            bottleneck = {"span": span, "_us": us, **entry}
+    if bottleneck is not None:
+        bottleneck.pop("_us")
+    return {
+        "coverage": direction_attribution.get("coverage"),
+        "exchange_device_ms": round(
+            float(direction_attribution.get("exchange_device_us") or 0.0) / 1e3, 6
+        ),
+        "attributed_ms": round(
+            float(direction_attribution.get("attributed_us") or 0.0) / 1e3, 6
+        ),
+        "hops": hops,
+        "bottleneck": bottleneck,
+        "bottleneck_axis": bottleneck["axis"] if bottleneck else None,
+        "fabric": "probed" if fabric_model else None,
+    }
+
+
 def render_markdown(report: dict) -> str:
     """The report as the PERF_NOTES-style markdown table."""
     peaks = report.get("peaks", {})
@@ -220,4 +292,42 @@ def render_markdown(report: dict) -> str:
             f"{f'{100 * frac:.1f}%' if frac is not None else ''} |"
         )
     lines.append("")
+    comms = report.get("comms")
+    if comms:
+        cov = comms.get("coverage")
+        lines += [
+            "## Comms roofline (per mesh hop)",
+            "",
+            f"- exchange device time: {comms.get('exchange_device_ms')} ms, "
+            f"direction coverage "
+            + (f"{100 * cov:.1f}%" if cov is not None else "n/a")
+            + (
+                ""
+                if comms.get("fabric")
+                else " (no fabric probe joined — probed ceilings null; run "
+                "`python -m stencil_tpu.fabric`)"
+            ),
+            "",
+            "| hop | device ms | events | bytes | GB/s | probed GB/s | % of link |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for span in sorted(comms.get("hops", {})):
+            e = comms["hops"][span]
+            frac = e.get("frac_of_link")
+            lines.append(
+                f"| `{span}` | {e['device_ms']} | {e['events']} | "
+                f"{e.get('bytes') or ''} | {e.get('gbps') or ''} | "
+                f"{e.get('probed_gbps') or ''} | "
+                f"{f'{100 * frac:.1f}%' if frac is not None else ''} |"
+            )
+        bn = comms.get("bottleneck")
+        if bn:
+            lines += [
+                "",
+                f"**Bottleneck: mesh axis `{bn['axis']}`** "
+                f"(`{bn.get('span')}`, {bn['device_ms']} ms of exchange "
+                "device time — the hop a topology/placement change must "
+                "shrink first).",
+            ]
+        lines.append("")
     return "\n".join(lines)

@@ -9,6 +9,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from stencil_tpu import analysis
+from stencil_tpu.telemetry import names as tm
 from stencil_tpu.utils.compat import shard_map
 
 
@@ -21,12 +22,12 @@ def build():
     def body(q0, q1):
         fused = jnp.concatenate([q0, q1], axis=0)
         for name, perm in (
-            ("halo_ppermute_x_from_low", fwd),
-            ("halo_ppermute_x_from_high", rev),
-            ("halo_ppermute_y_from_low", fwd),
-            ("halo_ppermute_y_from_high", rev),
-            ("halo_ppermute_z_from_low", fwd),
-            ("halo_ppermute_z_from_high", rev),
+            (tm.SPAN_EXCHANGE_X_LOW, fwd),
+            (tm.SPAN_EXCHANGE_X_HIGH, rev),
+            (tm.SPAN_EXCHANGE_Y_LOW, fwd),
+            (tm.SPAN_EXCHANGE_Y_HIGH, rev),
+            (tm.SPAN_EXCHANGE_Z_LOW, fwd),
+            (tm.SPAN_EXCHANGE_Z_HIGH, rev),
         ):
             with jax.named_scope(name):
                 fused = lax.ppermute(fused, "x", perm)

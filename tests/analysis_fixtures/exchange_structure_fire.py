@@ -10,6 +10,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from stencil_tpu import analysis
+from stencil_tpu.telemetry import names as tm
 from stencil_tpu.utils.compat import shard_map
 
 
@@ -22,10 +23,10 @@ def build():
     def body(q0, q1):
         out0, out1 = q0, q1
         for name, perm in (
-            ("halo_ppermute_x_from_low", fwd),
-            ("halo_ppermute_x_from_high", rev),
-            ("halo_ppermute_y_from_low", fwd),
-            ("halo_ppermute_y_from_high", rev),
+            (tm.SPAN_EXCHANGE_X_LOW, fwd),
+            (tm.SPAN_EXCHANGE_X_HIGH, rev),
+            (tm.SPAN_EXCHANGE_Y_LOW, fwd),
+            (tm.SPAN_EXCHANGE_Y_HIGH, rev),
         ):
             with jax.named_scope(name):
                 # BROKEN: one permute PER QUANTITY per direction — message

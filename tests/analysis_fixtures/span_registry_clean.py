@@ -1,7 +1,8 @@
 # analysis-fixture: contract=span-registry expect=clean
-"""Sanctioned scopes: a registered span constant, and an undotted local
-marker (outside the device-time attribution join, so not the registry's
-business)."""
+"""Sanctioned scopes: registered span constants only — the overlap interior
+span and a per-direction exchange span through the registry helper.  (The
+old undotted-local-marker escape hatch is gone: EVERY traced scope must be
+registered.)"""
 
 import jax
 import jax.numpy as jnp
@@ -14,7 +15,7 @@ def build():
     def step(x):
         with jax.named_scope(tm.SPAN_OVERLAP_INTERIOR):
             y = x * 2.0
-        with jax.named_scope("local_marker_scope"):
+        with jax.named_scope(tm.exchange_direction_span("z", "low")):
             return y + 1.0
 
     x = jax.ShapeDtypeStruct((8, 8), jnp.float32)

@@ -1,7 +1,8 @@
 # analysis-fixture: contract=span-registry expect=fire
-"""A broken scope: a dotted named-scope label assembled at trace time that
-no registry entry knows — the source-level span-name rule cannot see
-through the f-string, but the traced program carries the final string."""
+"""A broken scope: an exchange direction label assembled at trace time that
+no registry entry knows (a misspelled side) — the source-level span-name
+rule cannot see through the f-string, but the traced program carries the
+final string."""
 
 import jax
 import jax.numpy as jnp
@@ -10,10 +11,10 @@ from stencil_tpu import analysis
 
 
 def build():
-    half = "inter"  # defeat the AST rule the way real drift does
+    side = "low"  # defeat the AST rule the way real drift does
 
     def step(x):
-        with jax.named_scope(f"step.overlap.{half}ior_v2"):
+        with jax.named_scope(f"exchange.z.{side}ish"):
             return x * 2.0
 
     x = jax.ShapeDtypeStruct((8, 8), jnp.float32)

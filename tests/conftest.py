@@ -26,6 +26,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 import tempfile  # noqa: E402
 
 os.environ["STENCIL_TUNE_CACHE"] = tempfile.mkdtemp(prefix="stencil_tune_test_")
+# same hermeticity for the fabric observatory's link-matrix cache
+# (stencil_tpu/telemetry/fabric.py): a developer's probed matrices must not
+# warm-hit test ensure() calls, nor test probes pollute theirs
+os.environ["STENCIL_FABRIC_CACHE"] = tempfile.mkdtemp(prefix="stencil_fabric_test_")
 
 import jax  # noqa: E402
 

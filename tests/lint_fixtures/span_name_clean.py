@@ -2,6 +2,8 @@
 # The sanctioned pattern: span labels are SPAN constants from names.py
 # (device-time attribution keys on them), and non-literal labels pass
 # through unexamined (the runtime registry is the backstop).
+import jax
+
 from stencil_tpu import telemetry
 from stencil_tpu.telemetry import names as tm
 
@@ -11,6 +13,12 @@ with telemetry.span(tm.SPAN_STEP, histogram=tm.STEP_SECONDS):
     pass
 telemetry.record_span(tm.SPAN_EXCHANGE, 0.0, 0.25)
 
+with jax.named_scope(tm.SPAN_EXCHANGE_Z_LOW):  # a registered literal form
+    pass
 
-def dynamic(label):
-    return telemetry.annotate(label)  # parameterized: not a literal
+
+def dynamic(label, axis):
+    telemetry.annotate(label)  # parameterized: not a literal
+    # in-kernel direction scopes through the registry helper (the
+    # span-registry contract checks the resolved string at trace level)
+    return jax.named_scope(tm.exchange_direction_span(axis, "low"))

@@ -1,13 +1,18 @@
-# lint-fixture: select=span-name rel=stencil_tpu/fake.py expect=span-name,span-name,bad-suppression
+# lint-fixture: select=span-name rel=stencil_tpu/fake.py expect=span-name,span-name,span-name,bad-suppression
 # Seeded violations: a free-string annotate() scope (the device-attribution
-# gap) and a span() label that names a COUNTER constant's value (registered,
-# but not a span); a reasoned suppression silences a third site; a bare
+# gap), a span() label that names a COUNTER constant's value (registered,
+# but not a span), and a jax.named_scope() literal naming an UNREGISTERED
+# exchange direction; a reasoned suppression silences a fourth site; a bare
 # suppression fails.
+import jax
+
 from stencil_tpu import telemetry
 
 with telemetry.annotate("my.unregistered.scope"):
     pass
 with telemetry.span("domain.exchange.bytes"):  # a counter, not a span
+    pass
+with jax.named_scope("exchange.w.low"):  # no such mesh axis / span
     pass
 # stencil-lint: disable=span-name fixture: reasoned suppression silences the call below
 with telemetry.annotate("another.unregistered.scope"):

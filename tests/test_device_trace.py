@@ -16,6 +16,7 @@ from stencil_tpu.telemetry import names
 from stencil_tpu.telemetry.device import (
     ProfileCapture,
     attribute_device_time,
+    attribute_exchange_directions,
     device_pids,
     find_trace_files,
     load_trace_events,
@@ -23,6 +24,7 @@ from stencil_tpu.telemetry.device import (
     merge_into_chrome_trace,
 )
 from stencil_tpu.telemetry.roofline import (
+    comms_roofline,
     peaks_for,
     render_markdown,
     roofline_report,
@@ -86,15 +88,50 @@ class TestAttribution:
             800 + 700 + 150  # the interior-scope dot also carries the scope
         )
         assert att[names.SPAN_OVERLAP_EXTERIOR]["device_us"] == pytest.approx(400)
-        assert att["exchange"]["device_us"] == pytest.approx(40 + 260)
+        # six direction-scoped collective rows + one legacy halo_ppermute row
+        assert att["exchange"]["device_us"] == pytest.approx(640)
         assert att["pack"]["device_us"] == pytest.approx(120 + 90)
         assert att["mxu"]["device_us"] == pytest.approx(150)
         # total is device-only: the 5000us host enqueue row is excluded
         assert att["_total"]["device_us"] == pytest.approx(
-            800 + 700 + 40 + 260 + 120 + 90 + 400 + 150
+            800 + 700 + 640 + 120 + 90 + 400 + 150
         )
-        assert att["_total"]["events"] == 8
+        assert att["_total"]["events"] == 13
         assert att["_unattributed"]["events"] == 0
+
+    def test_exchange_direction_attribution(self):
+        """The per-direction pin: >=90% of exchange device time lands on a
+        REGISTERED ``exchange.<axis>.<side>`` scope — the fixture's one
+        legacy ``halo_ppermute_z`` row counts toward the exchange family
+        but against coverage."""
+        d = attribute_exchange_directions(_fixture_events())
+        dirs = d["directions"]
+        assert dirs[names.SPAN_EXCHANGE_Z_LOW]["device_us"] == pytest.approx(300)
+        assert dirs[names.SPAN_EXCHANGE_Z_HIGH]["device_us"] == pytest.approx(200)
+        assert dirs[names.SPAN_EXCHANGE_Y_LOW]["device_us"] == pytest.approx(100)
+        # directions the trace never exercised report zero, not absence
+        assert dirs[names.SPAN_EXCHANGE_X_LOW]["device_us"] == 0.0
+        assert d["exchange_device_us"] == pytest.approx(640)
+        assert d["attributed_us"] == pytest.approx(600)
+        assert d["coverage"] == pytest.approx(600 / 640)
+        assert d["coverage"] >= 0.90  # the acceptance floor
+        json.loads(json.dumps(d))
+
+    def test_host_only_dump_attributes_zero(self):
+        """A dump with process metadata but no device process (CPU backend)
+        attributes ZERO exchange time — never host wall-clock garbage."""
+        events = [
+            {"ph": "M", "pid": 2, "name": "process_name",
+             "args": {"name": "/host:CPU (pid 2)"}},
+            {"ph": "X", "pid": 2, "tid": 0, "name": "enqueue", "ts": 0.0,
+             "dur": 9999.0,
+             "args": {"name": "jit(step)/exchange.z.low/ppermute"}},
+        ]
+        d = attribute_exchange_directions(events)
+        assert d["exchange_device_us"] == 0.0
+        assert d["attributed_us"] == 0.0
+        assert d["coverage"] is None
+        assert all(r["device_us"] == 0.0 for r in d["directions"].values())
 
     def test_unattributed_remainder(self):
         events = [
@@ -120,7 +157,7 @@ class TestMerge:
         host = json.load(open(os.path.join(FIXTURE, "trace_0.json")))
         merged = merge_device_rows(host["traceEvents"], _fixture_events())
         dev_rows = [e for e in merged if e.get("pid", 0) >= 1000 and e["ph"] == "X"]
-        assert len(dev_rows) == 8
+        assert len(dev_rows) == 13
         texts = [
             e["name"] + " " + str(e.get("args", {})) for e in dev_rows
         ]
@@ -156,7 +193,7 @@ class TestMerge:
             e for e in doc["traceEvents"]
             if e.get("pid", 0) >= 1000 and e.get("ph") == "X"
         ]
-        assert len(dev_rows) == 8  # not 16
+        assert len(dev_rows) == 13  # not 26
         metas = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
         assert len(metas) == 1  # one device process announcement, not two
 
@@ -180,9 +217,9 @@ class TestRoofline:
     def test_join_bytes_and_flops(self):
         r = self._report(chip="TPU v5e")
         ex = r["phases"]["exchange"]
-        # 6291456 B over 300 us of collective time
+        # 6291456 B over 640 us of collective time
         assert ex["bytes"] == 6_291_456
-        assert ex["gbps"] == pytest.approx(6_291_456 / 300e-6 / 1e9, rel=1e-3)
+        assert ex["gbps"] == pytest.approx(6_291_456 / 640e-6 / 1e9, rel=1e-3)
         assert ex["frac_of_roofline"] == pytest.approx(ex["gbps"] / 819.0, rel=1e-2)
         mxu = r["phases"]["mxu"]
         assert mxu["flops"] == 4_194_304_000
@@ -190,7 +227,7 @@ class TestRoofline:
             4_194_304_000 / 150e-6 / 1e9, rel=1e-3
         )
         assert r["phases"][names.SPAN_OVERLAP_INTERIOR]["share_of_device"] > 0.5
-        assert r["total_device_ms"] == pytest.approx(2.56)
+        assert r["total_device_ms"] == pytest.approx(2.90)
         assert r["source"] == "device"
         json.loads(json.dumps(r))  # strict-JSON-safe
 
@@ -212,6 +249,54 @@ class TestRoofline:
         assert "| phase |" in md
         assert f"`{names.SPAN_OVERLAP_INTERIOR}`" in md
         assert "device truth" in md
+
+    def test_comms_roofline_join(self):
+        """The comms dimension: per-hop device time joined with the
+        analytic ``exchange.hop.*.bytes`` counters into achieved per-link
+        GB/s, bottleneck axis named (z: most exchange device time)."""
+        snap = json.load(open(os.path.join(FIXTURE, "metrics_0.json")))
+        comms = comms_roofline(
+            attribute_exchange_directions(_fixture_events()), snap
+        )
+        zl = comms["hops"][names.SPAN_EXCHANGE_Z_LOW]
+        assert zl["bytes"] == 3_145_728
+        assert zl["gbps"] == pytest.approx(3_145_728 / 300e-6 / 1e9, rel=1e-3)
+        assert zl["probed_gbps"] is None  # no fabric model joined
+        assert comms["bottleneck_axis"] == "z"
+        assert comms["bottleneck"]["span"] == names.SPAN_EXCHANGE_Z_LOW
+        assert comms["coverage"] >= 0.90
+        # unexercised directions ride along with null rates, not absence
+        assert comms["hops"][names.SPAN_EXCHANGE_X_HIGH]["gbps"] is None
+        json.loads(json.dumps(comms))
+        assert comms_roofline(None, snap) is None  # no trace -> no comms
+
+    def test_comms_roofline_fabric_join_and_markdown(self):
+        """With a probed link model joined, every measured hop reports its
+        fraction of the PROBED link bandwidth, and the markdown grows the
+        comms table + bottleneck callout."""
+        snap = json.load(open(os.path.join(FIXTURE, "metrics_0.json")))
+        fabric_model = {
+            "axes": {
+                "z": {"low": {"gbps_med": 50.0, "gbps_min": 45.0, "links": 2},
+                      "high": {"gbps_med": 50.0, "gbps_min": 45.0, "links": 2}},
+                "y": {"low": {"gbps_med": 90.0, "gbps_min": 90.0, "links": 2}},
+            },
+            "slowest": {"axis": "z", "side": "low", "gbps": 45.0,
+                        "src": 0, "dst": 1},
+        }
+        comms = comms_roofline(
+            attribute_exchange_directions(_fixture_events()), snap, fabric_model
+        )
+        zl = comms["hops"][names.SPAN_EXCHANGE_Z_LOW]
+        assert zl["probed_gbps"] == 50.0
+        assert zl["frac_of_link"] == pytest.approx(zl["gbps"] / 50.0, rel=1e-3)
+        assert comms["fabric"] == "probed"
+        report = self._report(chip="TPU v5e")
+        report["comms"] = comms
+        md = render_markdown(report)
+        assert "Comms roofline" in md
+        assert f"`{names.SPAN_EXCHANGE_Z_LOW}`" in md
+        assert "Bottleneck: mesh axis `z`" in md
 
 
 # --- scripts/perf_report.py --------------------------------------------------
@@ -241,6 +326,52 @@ class TestPerfReportScript:
             names.SPAN_OVERLAP_INTERIOR in str(e.get("args", {}))
             for e in dev_rows
         )
+
+    def test_comms_json_artifact_and_fabric_join(self, tmp_path, capsys):
+        """The machine-readable comms roofline: --json writes the
+        ``{"bench": "comms_roofline"}`` artifact (>=90% direction coverage
+        on the fixture, bottleneck axis named), --fabric joins probed
+        ceilings, and perf_ledger ingests the shape as exchange_hop:*
+        series."""
+        work = tmp_path / "telem"
+        shutil.copytree(FIXTURE, work)
+        fabric_doc = {
+            "schema": 1, "bench": "fabric_probe", "chip": "TPU v5e",
+            "topology": [1, 2, 2], "nbytes": 4096, "lat_nbytes": None,
+            "protocol": {"edges": 8}, "seconds": 0.5,
+            "links": [
+                {"axis": "z", "side": "low", "src": 0, "dst": 1, "gbps": 50.0},
+                {"axis": "z", "side": "high", "src": 1, "dst": 0, "gbps": 50.0},
+                {"axis": "y", "side": "low", "src": 0, "dst": 2, "gbps": 90.0},
+                {"axis": "y", "side": "high", "src": 2, "dst": 0, "gbps": 90.0},
+            ],
+            "matrix": [],
+        }
+        fabric_path = tmp_path / "fabric.json"
+        fabric_path.write_text(json.dumps(fabric_doc))
+        comms_path = tmp_path / "comms_roofline.json"
+        mod = _load_script("perf_report")
+        rc = mod.main([
+            str(work), "--chip", "TPU v5e",
+            "--fabric", str(fabric_path), "--json", str(comms_path),
+        ])
+        assert rc == 0
+        doc = json.load(open(comms_path))
+        assert doc["bench"] == "comms_roofline"
+        assert doc["coverage"] >= 0.90
+        assert doc["bottleneck_axis"] == "z"
+        zl = doc["hops"][names.SPAN_EXCHANGE_Z_LOW]
+        assert zl["probed_gbps"] == 50.0 and zl["frac_of_link"] is not None
+        # the full report embeds the same comms section
+        report = json.load(open(work / "roofline.json"))
+        assert report["comms"]["bottleneck_axis"] == "z"
+        # and the ledger ingests the artifact as exchange_hop:* series
+        from stencil_tpu.telemetry.ledger import entries_from_artifact
+
+        entries = entries_from_artifact(str(comms_path))
+        keys = {e["key"] for e in entries}
+        assert "exchange_hop:z.low:gbps" in keys
+        assert "exchange_hop:coverage" in keys
 
     def test_host_span_fallback_when_no_device_trace(self, tmp_path, capsys):
         """CPU dryrun containers: no profiler dump — the report degrades

@@ -100,9 +100,9 @@ def test_overlapped_step_schedule_straddles_interior():
     interior = [
         i
         for i, l in enumerate(lines)
-        if "interior_compute" in l and re.search(r"=\s+\S*\s*fusion", l)
+        if "step.overlap.interior" in l and re.search(r"=\s+\S*\s*fusion", l)
     ]
-    assert interior, "no interior_compute fusion found in scheduled module"
+    assert interior, "no interior fusion found in scheduled module"
     i0 = interior[0]
     lo, hi = _computation_block(lines, i0)
     starts = [
@@ -210,4 +210,4 @@ def test_no_overlap_step_schedule_serializes():
 
     step = dd.make_step(_jacobi_kernel, overlap=False, donate=False)
     text = step.lower(dd.abstract_arrays(), 1).compile().as_text()
-    assert "interior_compute" not in text
+    assert "step.overlap.interior" not in text

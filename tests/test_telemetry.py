@@ -76,12 +76,29 @@ class TestMetrics:
         r.counter("resilience.retry.attempts").inc()
         r.counter("resilience.retry.attempts").inc(2)
         r.gauge("domain.exchange.bytes_per_exchange").set(1536)
-        snap = r.snapshot(seed_counters=names.ALL_COUNTERS)
+        snap = r.snapshot(
+            seed_counters=names.ALL_COUNTERS,
+            seed_histograms=names.ALL_HISTOGRAMS,
+        )
         assert snap["counters"]["resilience.retry.attempts"] == 3
-        # seeded: every canonical counter appears even when untouched
+        # seeded: every canonical counter appears even when untouched —
+        # including the fabric observatory's new per-hop byte counters
         assert snap["counters"]["resilience.sentinel.trips"] == 0
+        assert snap["counters"][names.EXCHANGE_HOP_Z_LOW_BYTES] == 0
+        assert snap["counters"][names.FABRIC_PROBE_RUNS] == 0
         assert set(names.ALL_COUNTERS) <= set(snap["counters"])
+        # seeded histograms: every canonical name appears as an EMPTY
+        # distribution (count 0, None stats) so cross-round diffs of e.g.
+        # fabric.link.gbps never KeyError on a fresh registry
+        assert set(names.ALL_HISTOGRAMS) <= set(snap["histograms"])
+        empty = snap["histograms"][names.FABRIC_LINK_GBPS]
+        assert empty["count"] == 0 and empty["med"] is None
+        json.loads(json.dumps(snap))  # seeded shape stays strict-JSON-safe
         assert snap["gauges"]["domain.exchange.bytes_per_exchange"] == 1536.0
+        # the facade snapshot seeds both kinds the same way
+        assert set(names.ALL_HISTOGRAMS) <= set(
+            telemetry.snapshot()["histograms"]
+        )
 
     def test_histogram_matches_statistics_and_json_safety(self):
         from stencil_tpu.utils.statistics import Statistics
@@ -144,9 +161,10 @@ class TestMetrics:
         assert not telemetry.enabled()
         telemetry.inc(names.RETRY_ATTEMPTS)
         assert telemetry.snapshot()["counters"][names.RETRY_ATTEMPTS] == 1
-        # histograms are NOT recorded while disabled (hot-path zero cost)
+        # histograms are NOT recorded while disabled (hot-path zero cost) —
+        # the name still appears (canonical seeding), but stays empty
         telemetry.observe(names.STEP_SECONDS, 1.0)
-        assert names.STEP_SECONDS not in telemetry.snapshot()["histograms"]
+        assert telemetry.snapshot()["histograms"][names.STEP_SECONDS]["count"] == 0
 
 
 # --- spans + chrome trace ----------------------------------------------------
@@ -542,8 +560,9 @@ class TestDomainAccounting:
         dd.exchange()
         dd.swap()
         assert dd.stats.time_exchange > 0
-        # but no histogram was recorded (telemetry off)
-        assert names.EXCHANGE_SECONDS not in telemetry.snapshot()["histograms"]
+        # but no histogram was recorded (telemetry off) — the canonical name
+        # is still seeded, empty
+        assert telemetry.snapshot()["histograms"][names.EXCHANGE_SECONDS]["count"] == 0
 
     def test_run_step_macro_accounting(self, tmp_path):
         """Under a halo multiplier the xla engine's macro step advances mult

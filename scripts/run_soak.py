@@ -44,7 +44,13 @@ fault-isolation contract —
   tenant (every envelope stays active);
 * the elastic leg (load-driven grow/shrink through
   ``DistributedDomain.reshard``) stays bitwise identical to its
-  fixed-mesh twin and decides exactly one grow + one shrink.
+  fixed-mesh twin and decides exactly one grow + one shrink;
+* the packed legs (docs/serving.md "Throughput"): ``--batch 8`` batched
+  dispatch and ``--subslice`` bin-packing each reproduce the serial
+  reference digest-for-digest while demonstrably engaging (batch-size /
+  sub-slice histograms non-empty), and a ``poison_request`` against one
+  member of a batch falls back to serial re-execution — the poisoned
+  tenant evicted, every healthy batch member still bitwise identical.
 
 The verdict lands in ``serve_summary.json`` (``bench: "serve_soak"``,
 ``isolation_ok``) — ``scripts/perf_ledger.py`` ingests the reference
@@ -276,24 +282,33 @@ def serve_soak(args) -> int:
         "--elastic", "--elastic-high", "4", "--elastic-low", "0",
         "--elastic-consecutive", "3",
     ]
-    flight.heartbeat(0, 6, stage="reference")
+    flight.heartbeat(0, 9, stage="reference")
     ref = serve_leg(args, "ref", [])
-    flight.heartbeat(1, 6, stage="poison")
+    flight.heartbeat(1, 9, stage="poison")
     poison = serve_leg(
         args, "poison", [],
         fault_plan="execute:poison_request:serve:tenant-b@1",
     )
-    flight.heartbeat(2, 6, stage="vmem")
+    flight.heartbeat(2, 9, stage="vmem")
     vmem = serve_leg(
         args, "vmem", [], fault_plan="execute:vmem_oom:serve:tenant-c@1"
     )
-    flight.heartbeat(3, 6, stage="overload")
+    flight.heartbeat(3, 9, stage="overload")
     overload = serve_leg(
         args, "overload", [], fault_plan="dispatch:overload:serve:*@2*3"
     )
-    flight.heartbeat(4, 6, stage="elastic")
+    flight.heartbeat(4, 9, stage="batched")
+    batched = serve_leg(args, "batched", ["--batch", "8"])
+    flight.heartbeat(5, 9, stage="subslice")
+    sub = serve_leg(args, "subslice", ["--subslice"])
+    flight.heartbeat(6, 9, stage="batched-poison")
+    bpoison = serve_leg(
+        args, "batched_poison", ["--batch", "8"],
+        fault_plan="execute:poison_request:serve:tenant-b@1",
+    )
+    flight.heartbeat(7, 9, stage="elastic")
     el = serve_leg(args, "elastic", elastic)
-    flight.heartbeat(5, 6, stage="elastic-fixed")
+    flight.heartbeat(8, 9, stage="elastic-fixed")
     el_fix = serve_leg(args, "elastic_fixed", elastic + ["--fixed-mesh"])
 
     def states(doc):
@@ -327,6 +342,23 @@ def serve_soak(args) -> int:
         == ["grow", "shrink"]
         and sorted({t["kind"] for t in el["elasticity"]["transitions"]})
         == ["grow", "shrink"],
+        # batched dispatch reproduces the serial reference digest-for-digest
+        # AND demonstrably engaged (the always-live dispatch counter — a
+        # trivially-serial run matching digests proves nothing)
+        "batched_bitwise": batched["digests"] == ref["digests"]
+        and batched["counters"].get("serve.batch.dispatches", 0) > 0,
+        # sub-slice bin-packing likewise: digests identical, slices placed
+        "subslice_bitwise": sub["digests"] == ref["digests"]
+        and sub["counters"].get("serve.subslice.dispatches", 0) > 0,
+        # poison against one member of a batch: the batch falls back to
+        # serial re-execution (fallback counter fires), the poisoned tenant
+        # is evicted, and every HEALTHY batch member stays bitwise identical
+        # to the fault-free reference.  (The poisoned tenant's own digest is
+        # not pinned: eviction lands earlier under batching, so fewer of its
+        # requests are admitted — the isolation contract covers neighbors.)
+        "batched_poison_isolated": states(bpoison)["tenant-b"] != "active"
+        and healthy_identical(bpoison, "tenant-b")
+        and bpoison["counters"].get("serve.batch.fallbacks", 0) >= 1,
     }
     isolation_ok = all(checks.values())
     summary = {
@@ -343,9 +375,17 @@ def serve_soak(args) -> int:
             "ref": ref["digests"],
             "poison": poison["digests"],
             "vmem": vmem["digests"],
+            "batched": batched["digests"],
+            "subslice": sub["digests"],
+            "batched_poison": bpoison["digests"],
             "elastic": el["digests"],
             "elastic_fixed": el_fix["digests"],
         },
+        # the packed leg's throughput is the headline the perf ledger tracks
+        # (higher-is-better serve:throughput); the serial reference rides
+        # along so a ledger reader can see the batching win in one artifact
+        "throughput": batched.get("throughput"),
+        "throughput_ref": ref.get("throughput"),
         "elasticity": el["elasticity"],
         "isolation_ok": isolation_ok,
     }
@@ -353,7 +393,7 @@ def serve_soak(args) -> int:
     atomic_write_json(path, summary)
     print(json.dumps(summary))
     flight.heartbeat(
-        6, 6, phase="completed" if isolation_ok else "failed",
+        9, 9, phase="completed" if isolation_ok else "failed",
         stage="verify", isolation_ok=isolation_ok,
     )
     if not isolation_ok:
@@ -366,8 +406,9 @@ def serve_soak(args) -> int:
         return 1
     print(
         "OK: poison/vmem isolated bitwise, overload shed "
-        f"{overload['shed']} without evictions, elasticity one grow + one "
-        f"shrink bitwise identical ({path})",
+        f"{overload['shed']} without evictions, batched/subslice packed "
+        "legs bitwise identical (poison-in-batch fell back serial), "
+        f"elasticity one grow + one shrink bitwise identical ({path})",
         file=sys.stderr,
     )
     return 0

@@ -263,8 +263,13 @@ class SliverDus(Contract):
         # the redistribution schedule writes staging windows whose extents
         # are whatever the mesh intersection yields — a one-shot capacity
         # transition, not a per-step hot path; its own contract
-        # (redistribute-bounded) checks what actually matters there
-        return art.kind != "redistribute"
+        # (redistribute-bounded) checks what actually matters there.  The
+        # serve programs wrap whatever step each TENANT built (the
+        # baseline XLA route included, whose shell scatter this trap is a
+        # known property of) — the per-engine step programs already hold
+        # this pin on the streamed hot paths, and batch-isolation checks
+        # what packing itself must guarantee
+        return art.kind not in ("redistribute", "serve")
 
     def check(self, art: ProgramArtifact) -> List[Finding]:
         from stencil_tpu.analysis import jaxpr as jx
@@ -578,6 +583,216 @@ class NumericsBounded(Contract):
                     "not the domain",
                 )
             )
+        return out
+
+
+#: named-axis collectives whose axis names the batch-isolation contract
+#: inspects — a collective naming the BATCH axis (vmap's axis, not a mesh
+#: axis) mixes tenants that share a batched dispatch
+_NAMED_COLLECTIVES = frozenset(
+    {
+        "ppermute",
+        "psum",
+        "psum2",
+        "pmin",
+        "pmax",
+        "pbroadcast",
+        "all_gather",
+        "all_gather_invariant",
+        "all_to_all",
+    }
+)
+
+
+def _collective_axes(eqn) -> list:
+    """Every axis a collective eqn communicates over (ppermute spells them
+    ``axis_name``, psum and friends ``axes``).  Mesh-axis collectives carry
+    the axis NAME (a string); a collective traced through ``vmap`` carries
+    the POSITIONAL batch axis as an int — both are returned, because in a
+    batched serving program an int axis IS the batch axis."""
+    axes = []
+    for key in ("axis_name", "axes"):
+        val = eqn.params.get(key)
+        if val is None:
+            continue
+        if not isinstance(val, (tuple, list)):
+            val = (val,)
+        axes.extend(val)
+    return axes
+
+
+@register
+class BatchIsolation(Contract):
+    name = "batch-isolation"
+    why = (
+        "a packed serving dispatch must not couple tenants: in a BATCHED "
+        "program no collective communicates over the batch axis (only the "
+        "mesh axes) and every output keeps its leading batch dim; in a "
+        "SUB-SLICE program no tenant's outputs are dataflow-reachable "
+        "from another tenant's inputs and every shard_map stays confined "
+        "to exactly one tenant's device set; neither form may gather — "
+        "cross-tenant coupling would pass every single-tenant test and "
+        "corrupt a neighbor only under production packing (serve/pack.py)"
+    )
+
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        return art.kind == "serve"
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import jaxpr as jx
+
+        out: List[Finding] = []
+        mode = art.meta.get("mode")
+        if mode not in ("batched", "subslice"):
+            return [
+                art.finding(
+                    self.name,
+                    f"serve artifact carries meta['mode']={mode!r} — the "
+                    "isolation claims cannot be verified",
+                )
+            ]
+        for e in jx.iter_eqns(art.closed):
+            if e.primitive.name in _GATHERING_PRIMITIVES:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"{e.primitive.name} (scope "
+                        f"{jx.name_stack_str(e)!r}) — a gathering "
+                        "collective in a packed serving program "
+                        "materializes state across tenants",
+                    )
+                )
+        if mode == "batched":
+            out.extend(self._check_batched(art, jx))
+        else:
+            out.extend(self._check_subslice(art, jx))
+        return out
+
+    def _check_batched(self, art: ProgramArtifact, jx) -> List[Finding]:
+        out: List[Finding] = []
+        batch = art.meta.get("batch")
+        mesh_axes = set(art.meta.get("mesh_axes") or ())
+        if not isinstance(batch, int) or batch < 2 or not mesh_axes:
+            return [
+                art.finding(
+                    self.name,
+                    "batched artifact needs meta['batch'] >= 2 and "
+                    "meta['mesh_axes'] — the batch-axis claims cannot be "
+                    "verified",
+                )
+            ]
+        for e in jx.iter_eqns(art.closed):
+            if e.primitive.name not in _NAMED_COLLECTIVES:
+                continue
+            stray = [
+                n for n in _collective_axes(e)
+                if not (isinstance(n, str) and n in mesh_axes)
+            ]
+            if stray:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"{e.primitive.name} communicates over non-mesh "
+                        f"axis(es) {stray} (scope "
+                        f"{jx.name_stack_str(e)!r}) — a collective over "
+                        "the batch axis mixes tenants that share one "
+                        "batched dispatch",
+                    )
+                )
+        jaxpr = getattr(art.closed, "jaxpr", art.closed)
+        for v in jaxpr.outvars:
+            shape = tuple(getattr(getattr(v, "aval", None), "shape", ()))
+            if not shape or shape[0] != batch:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"output with shape {shape} does not keep the "
+                        f"leading batch dim {batch} — per-tenant slices "
+                        "cannot be separated back out of the dispatch",
+                    )
+                )
+        return out
+
+    def _check_subslice(self, art: ProgramArtifact, jx) -> List[Finding]:
+        out: List[Finding] = []
+        in_groups = art.meta.get("input_groups")
+        out_groups = art.meta.get("output_groups")
+        device_sets = [
+            frozenset(s) for s in (art.meta.get("device_sets") or [])
+        ]
+        jaxpr = getattr(art.closed, "jaxpr", art.closed)
+        if (
+            not in_groups
+            or not out_groups
+            or len(device_sets) != len(in_groups)
+            or sum(in_groups) != len(jaxpr.invars)
+            or sum(out_groups) != len(jaxpr.outvars)
+        ):
+            return [
+                art.finding(
+                    self.name,
+                    "subslice artifact needs matching meta['input_groups']/"
+                    "['output_groups']/['device_sets'] — the per-tenant "
+                    "isolation claims cannot be verified",
+                )
+            ]
+        # slice the flat invar/outvar lists back into per-tenant groups
+        # (the builder records the pytree flatten order)
+        in_of, out_of, i, o = [], [], 0, 0
+        for n_in, n_out in zip(in_groups, out_groups):
+            in_of.append(list(jaxpr.invars[i : i + n_in]))
+            out_of.append(list(jaxpr.outvars[o : o + n_out]))
+            i += n_in
+            o += n_out
+        # per-tenant forward taint at the top level: seed every OTHER
+        # tenant's inputs, flow conservatively through the top-level eqns
+        # (pjit boundaries — a traced sub-call mixes whatever it consumes),
+        # and require this tenant's outputs stay untainted
+        for t in range(len(in_groups)):
+            tainted = set()
+            for s, group in enumerate(in_of):
+                if s != t:
+                    tainted.update(id(v) for v in group)
+            for e in jaxpr.eqns:
+                if any(
+                    id(v) in tainted
+                    for v in e.invars
+                    if not isinstance(v, jx.Literal)
+                ):
+                    tainted.update(id(v) for v in e.outvars)
+            dirty = [v for v in out_of[t] if id(v) in tainted]
+            if dirty:
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"tenant {t}'s output(s) are dataflow-reachable "
+                        f"from another tenant's inputs ({len(dirty)} of "
+                        f"{len(out_of[t])} outputs tainted) — sub-slice "
+                        "execution is not isolated",
+                    )
+                )
+        # every shard_map must stay confined to exactly one tenant's
+        # declared device set — an eqn spanning two sets is a collective
+        # bridge between "disjoint" sub-slices
+        for e in jx.iter_eqns(art.closed):
+            if e.primitive.name != "shard_map":
+                continue
+            mesh = e.params.get("mesh")
+            devs = getattr(mesh, "devices", None)
+            if devs is None:
+                continue
+            ids = {int(d.id) for d in devs.flat}
+            if not any(ids <= s for s in device_sets):
+                out.append(
+                    art.finding(
+                        self.name,
+                        f"shard_map over devices {sorted(ids)} (scope "
+                        f"{jx.name_stack_str(e)!r}) is not confined to "
+                        "any single tenant's declared device set "
+                        f"{[sorted(s) for s in device_sets]} — its "
+                        "collectives bridge sub-slices",
+                    )
+                )
         return out
 
 

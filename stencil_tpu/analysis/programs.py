@@ -64,7 +64,7 @@ class ProgramSpec:
     """One canonical program: what to build and which axes it exercises."""
 
     label: str
-    kind: str = "step"  # "step" | "exchange" | "redistribute" | "numerics"
+    kind: str = "step"  # "step"|"exchange"|"redistribute"|"numerics"|"serve"
     size: tuple = (16, 16, 16)
     n_devices: int = MATRIX_DEVICES
     halo_mult: int = 1
@@ -77,6 +77,7 @@ class ProgramSpec:
     mxu_input: str = "f32"
     storage_dtype: str = "native"
     reshard_to: tuple = ()  # redistribute only: the target mesh dim
+    serve_mode: str = ""  # serve only: "batched" | "subslice" (pack.SERVE_MODES)
 
     @property
     def axes(self) -> dict:
@@ -214,6 +215,19 @@ CANONICAL_PROGRAMS: List[ProgramSpec] = [
         halo_mult=2,
         reshard_to=(2, 2, 1),
     ),
+    # the serving layer's packed dispatches (serve/pack.py — one program
+    # per SERVE_MODES value, the batch-isolation contract's corpus):
+    # "batched" traces the REAL batched callable (make_batched_dispatch
+    # over a full-fleet XLA-engine step, leading batch axis 4) and pins
+    # that no collective ever communicates over the batch axis and every
+    # output keeps its batch dim; "subslice" traces two tenants' steps on
+    # DISJOINT 4-chip sub-meshes through one program and pins that no
+    # tenant's outputs are reachable from another tenant's inputs and
+    # every shard_map stays confined to its tenant's device set.
+    ProgramSpec("serve:batched", kind="serve", serve_mode="batched"),
+    ProgramSpec(
+        "serve:subslice", kind="serve", serve_mode="subslice", n_devices=4
+    ),
 ]
 
 
@@ -229,7 +243,13 @@ def covered_axis_values() -> dict:
         "MXU_INPUTS": set(),
         "STORAGE_DTYPES": set(),
     }
+    out["SERVE_MODES"] = set()
     for s in CANONICAL_PROGRAMS:
+        if s.kind == "serve":
+            # a serve program's step axes are incidental (the packers ride
+            # whatever steps the tenants built); only its MODE is coverage
+            out["SERVE_MODES"].add(s.serve_mode)
+            continue
         out["EXCHANGE_ROUTES"].add(s.exchange_route)
         out["STREAM_OVERLAP"].add(s.overlap)
         out["STREAM_HALO"].add(s.halo)
@@ -350,10 +370,93 @@ def _numerics_artifact(spec: ProgramSpec, dd) -> ProgramArtifact:
     )
 
 
+def _serve_artifact(spec: ProgramSpec, dd) -> ProgramArtifact:
+    """Trace the serving layer's packed-dispatch programs (serve/pack.py)
+    for the batch-isolation contract.
+
+    ``batched`` — the REAL batched callable (``ops/stream.py
+    make_batched_dispatch``) over a full-fleet XLA-engine step, batch 4;
+    meta carries the batch extent and the mesh axis names so the contract
+    can pin "no collective over the batch axis" and "outputs keep the
+    batch dim".
+
+    ``subslice`` — two tenants' steps on DISJOINT sub-meshes (devices
+    [0:n) and [n:2n)) traced through ONE program ``(cA, cB) -> (outA,
+    outB)``; meta carries the per-tenant input/output leaf counts (the
+    pytree flatten order: tenant A's fields then tenant B's) and device
+    sets so the contract can hold the cross-tenant taint and shard_map
+    confinement claims."""
+    import jax
+    import jax.numpy as jnp
+
+    from stencil_tpu.ops.stream import make_batched_dispatch
+    from stencil_tpu.parallel.mesh import MESH_AXES
+
+    if spec.serve_mode == "batched":
+        step = dd.make_step(mean6_kernel, donate=False)
+        batched = make_batched_dispatch(step, 1, "vmap")
+        batch = 4
+        stacked = {
+            k: jnp.stack([v] * batch) for k, v in dd._curr.items()
+        }
+        closed = jax.make_jaxpr(batched)(stacked)
+        return ProgramArtifact(
+            label=spec.label,
+            kind="serve",
+            closed=closed,
+            dd=dd,
+            n_devices=spec.n_devices,
+            meta={
+                "mode": "batched",
+                "batch": batch,
+                "mesh_axes": tuple(MESH_AXES),
+            },
+        )
+    from stencil_tpu.core.radius import Radius
+    from stencil_tpu.domain import DistributedDomain
+
+    devices = jax.devices()
+    dd_b = DistributedDomain(*spec.size)
+    dd_b.set_radius(Radius.constant(1))
+    dd_b.set_devices(devices[spec.n_devices : 2 * spec.n_devices])
+    handles = [dd_b.add_data(f"q{i}") for i in range(spec.n_fields)]
+    dd_b.realize()
+    for i, h in enumerate(handles):
+        dd_b.init_by_coords(
+            h, lambda x, y, z, i=i: jnp.cos(0.11 * (x + 2 * y + 3 * z) + i)
+        )
+    step_a = dd.make_step(mean6_kernel, donate=False)
+    step_b = dd_b.make_step(mean6_kernel, donate=False)
+
+    def both(c_a, c_b):
+        return step_a(c_a, 1), step_b(c_b, 1)
+
+    closed = jax.make_jaxpr(both)(dd._curr, dd_b._curr)
+    sets = [
+        sorted(d.id for d in dd.mesh.devices.flat),
+        sorted(d.id for d in dd_b.mesh.devices.flat),
+    ]
+    return ProgramArtifact(
+        label=spec.label,
+        kind="serve",
+        closed=closed,
+        dd=dd,
+        n_devices=2 * spec.n_devices,
+        meta={
+            "mode": "subslice",
+            "input_groups": [len(dd._curr), len(dd_b._curr)],
+            "output_groups": [len(dd._curr), len(dd_b._curr)],
+            "device_sets": sets,
+        },
+    )
+
+
 def build_program(spec: ProgramSpec) -> ProgramArtifact:
     """Really build and trace one canonical program (interpret/CPU mode)."""
     with tpu_shaped_trace():
         dd = _build_domain(spec)
+        if spec.kind == "serve":
+            return _serve_artifact(spec, dd)
         if spec.kind == "numerics":
             return _numerics_artifact(spec, dd)
         if spec.kind == "redistribute":

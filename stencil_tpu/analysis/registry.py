@@ -55,4 +55,8 @@ CANONICAL_AXES = {
         "module": "stencil_tpu/ops/jacobi_pallas.py",
         "covered": ("native", "bf16"),
     },
+    "SERVE_MODES": {
+        "module": "stencil_tpu/serve/pack.py",
+        "covered": ("batched", "subslice"),
+    },
 }

@@ -47,15 +47,17 @@ def _tuple_of_strs(node: ast.expr) -> Optional[List[str]]:
 class ContractCoverageRule(Rule):
     name = "contract-coverage"
     why = (
-        "an ops/ module growing a tuner-axis vocabulary (EXCHANGE_ROUTES, "
-        "STREAM_OVERLAP, ...) must be named in the analysis canonical-"
-        "matrix ledger — new routes cannot ship unverified by the program "
-        "contracts"
+        "an ops/ or serve/ module growing an axis vocabulary "
+        "(EXCHANGE_ROUTES, STREAM_OVERLAP, SERVE_MODES, ...) must be named "
+        "in the analysis canonical-matrix ledger — new routes cannot ship "
+        "unverified by the program contracts"
     )
 
     def applies_to(self, rel: str) -> bool:
         rel = rel.replace("\\", "/")
-        return rel.startswith("stencil_tpu/ops/")
+        # serve/ carries one axis vocabulary too: pack.SERVE_MODES (the
+        # packed-dispatch modes the batch-isolation contract sweeps)
+        return rel.startswith(("stencil_tpu/ops/", "stencil_tpu/serve/"))
 
     def check(self, ctx: FileContext) -> List[Violation]:
         ledger = _ledger()

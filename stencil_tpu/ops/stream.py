@@ -2225,3 +2225,67 @@ def make_stream_step(
     step._resilience = ladder
     step._resilience_label = "stream"
     return step
+
+
+# --- batched dispatch (serve/pack.py) ----------------------------------------
+#
+# The serving layer's batch planner stacks geometry-matched tenant states
+# along a leading axis and runs them as ONE dispatch.  How the batch axis
+# is carried depends on the engine under the step:
+#
+# * the XLA slice engine (``make_step``'s jnp route) is plain traceable
+#   jax — ``vmap`` threads the batch axis straight through the shard_map
+#   and its ppermutes, and XLA fuses the batched program;
+# * the plane pipeline (``make_stream_step``) bottoms out in pallas_call
+#   grids whose VMEM plane rings are sized for ONE shard — vmap over a
+#   pallas grid is not a supported lowering, so the batch axis is carried
+#   as an EXPLICIT leading dim instead: ``lax.scan`` over the stacked
+#   states calls the unbatched pass once per element inside one jitted
+#   program (one dispatch at the host boundary, which is what serving
+#   throughput is bounded by — see docs/serving.md "Throughput").
+#
+# Either way the per-element program is the UNBATCHED step itself, so each
+# tenant's slice is bitwise-identical to a serial dispatch (the soak's
+# packed legs pin this digest-for-digest).
+
+
+def batch_axis_mode(step) -> str:
+    """How a batched dispatch must carry the leading batch axis over
+    ``step``: ``"vmap"`` for traceable-jax steps, ``"leading_dim"`` (an
+    explicit scan) for plane-pipeline steps (``_stream_plan`` present)
+    whose pallas grids vmap cannot lower."""
+    return (
+        "leading_dim"
+        if getattr(step, "_stream_plan", None) is not None
+        else "vmap"
+    )
+
+
+def make_batched_dispatch(
+    step_fn: Callable, steps: int, mode: str
+) -> Callable:
+    """One jitted callable running ``step_fn(curr, steps)`` over every
+    element of a stacked state dict (leading batch axis), per ``mode``
+    (see ``batch_axis_mode``).  ``step_fn`` must be the RESOLVED per-shard
+    callable — a raw ``make_step`` jit or a ladder's ``built()`` — not the
+    telemetry-wrapping closure.  The stacked input is donated: callers
+    stack with ``jnp.stack`` (a copy), so the per-tenant source buffers
+    stay live for the serial fallback path."""
+    if mode not in ("vmap", "leading_dim"):
+        raise ValueError(
+            f"unknown batch axis mode {mode!r} (vmap | leading_dim)"
+        )
+    if mode == "vmap":
+
+        def batched(stacked):
+            return jax.vmap(lambda c: step_fn(c, steps))(stacked)
+
+    else:
+
+        def batched(stacked):
+            def body(carry, c):
+                return carry, step_fn(c, steps)
+
+            return lax.scan(body, 0, stacked)[1]
+
+    return jax.jit(batched, donate_argnums=0)

@@ -10,13 +10,17 @@ Public surface (docs/serving.md):
 * :class:`AOTCache` — warm executables by ``tune/key.py`` digest;
 * :class:`Tenant` — the per-tenant resilience envelope;
 * :class:`ElasticityPolicy` — queue depth -> grow/shrink with hysteresis;
-* :class:`StencilServer` — the serving loop tying them together.
+* :class:`StencilServer` — the serving loop tying them together;
+* ``pack`` — the throughput packers: the geometry-matched batch planner
+  (``plan_batches`` / :class:`BatchExecutor`) and the fabric-scored
+  sub-slice bin-packer (``plan_subslices`` / ``place_subslices``).
 
 The driver is ``python -m stencil_tpu.bin.stencil_serve`` (synthetic load
 generator included); the serving chaos soak is ``scripts/run_soak.py
 --serve``.
 """
 
+from stencil_tpu.serve import pack
 from stencil_tpu.serve.aot import AOTCache
 from stencil_tpu.serve.policy import ElasticityPolicy
 from stencil_tpu.serve.queue import BoundedQueue
@@ -42,4 +46,5 @@ __all__ = [
     "StencilServer",
     "Tenant",
     "TenantSpec",
+    "pack",
 ]

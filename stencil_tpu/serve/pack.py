@@ -1,0 +1,284 @@
+"""Throughput packing: the batch planner and the sub-slice bin-packer.
+
+PR 16's server dispatches ONE request per cycle — tenants timeshare the
+fleet serially, so aggregate throughput is 1/N of what the hardware can
+deliver.  This module gives ``StencilServer.cycle`` two concurrency
+mechanisms behind one scheduler (docs/serving.md "Throughput: batching
+and sub-slice packing"), both bitwise-pinned by the soak's packed legs:
+
+* **batched dispatch** — requests whose workloads share a step GEOMETRY
+  (same domain shape / mesh / route / dtype — the same tuple the AOT
+  cache key digests) stack along a leading batch axis and run as ONE
+  dispatch: ``vmap`` over the jitted step where the route permits, or an
+  explicit leading dim (``lax.scan``) for the plane-pipeline routes vmap
+  cannot carry (``ops/stream.py make_batched_dispatch``).  Per-tenant
+  outputs slice back out; a classified failure against any member falls
+  the whole group back to serial re-execution so the per-tenant fault
+  envelopes keep their exact semantics.
+
+* **sub-slice bin-packing** — tenants whose shapes DON'T match get
+  bin-packed onto disjoint contiguous sub-slices of the fleet (greedy
+  decreasing by state footprint, each tenant taking the cheapest
+  remaining slice under the measured ``fabric.link_model`` cost — the
+  serving-time analog of the reference's QAP-over-measured-distances
+  placement, PAPER.md L5), then dispatched back-to-back WITHOUT an
+  intermediate block so async dispatch overlaps their execution on the
+  disjoint device sets.
+
+Disjointness is not a comment: the ``batch-isolation`` program contract
+(analysis/contracts.py) machine-checks the traced canonical programs
+``serve:batched`` / ``serve:subslice`` — no cross-tenant dataflow, no
+gathering collective, collectives confined to each sub-slice's mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from stencil_tpu.utils.logging import log_info
+
+#: the packed dispatch modes the scheduler can pick (serial dispatch is
+#: the ABSENCE of packing, not a mode).  The canonical-program matrix
+#: must trace one program per mode (``serve:batched``/``serve:subslice``
+#: in analysis/programs.py) — analysis/registry.py CANONICAL_AXES pins
+#: this tuple against that matrix.
+SERVE_MODES = ("batched", "subslice")
+
+
+# --- geometry keys -----------------------------------------------------------
+
+
+def geometry_key(model, steps: int) -> Optional[tuple]:
+    """The batch-compatibility key: two requests may share one batched
+    dispatch iff their keys are equal — same autotuner workload digest
+    (chip / domain / mesh / route / dtype, ``tune/key.py``), same buffer
+    shapes+dtypes (halo multiplier and storage axis included), same
+    device placement, same step count.  ``None`` = not batchable (no
+    realized domain under the model)."""
+    dd = getattr(model, "dd", None)
+    step = getattr(model, "_step", None)
+    if dd is None or step is None or not getattr(dd, "_realized", False):
+        return None
+    buffers = tuple(
+        (name, tuple(arr.shape), str(arr.dtype))
+        for name, arr in sorted(dd._curr.items())
+    )
+    devices = tuple(sorted(d.id for d in dd.mesh.devices.flat))
+    return (
+        dd.tune_key(dd.exchange_route()).digest(),
+        buffers,
+        devices,
+        int(steps),
+    )
+
+
+def footprint_bytes(model) -> int:
+    """The tenant's resident field-state bytes — the greedy bin-packer's
+    decreasing sort key (the biggest tenant chooses its slice first)."""
+    dd = getattr(model, "dd", None)
+    if dd is None or not getattr(dd, "_realized", False):
+        return 0
+    return sum(int(arr.nbytes) for arr in dd._curr.values())
+
+
+def _packable(tenant) -> bool:
+    return (
+        tenant is not None
+        and tenant.active()
+        and tenant.model is not None
+        and getattr(tenant.model, "dd", None) is not None
+        and getattr(tenant.model.dd, "_realized", False)
+    )
+
+
+def _oldest_per_tenant(pending, rotation) -> "List":
+    """The oldest queued request of each tenant, in rotation-fair order
+    (tenants outside the rotation ride at the back in queue order)."""
+    oldest: Dict[str, object] = {}
+    for r in pending:
+        if r.tenant not in oldest:
+            oldest[r.tenant] = r
+    order = [t for t in rotation if t in oldest]
+    order += [t for t in oldest if t not in order]
+    return [oldest[t] for t in order]
+
+
+# --- the batch planner -------------------------------------------------------
+
+
+def plan_batches(pending, tenants, rotation, batch_max: int):
+    """Pick ONE batch group: the oldest queued request of each packable
+    tenant, grouped by ``geometry_key``; the first group (rotation-fair
+    order) with >= 2 members dispatches together, capped at ``batch_max``.
+    Returns the request list, or ``None`` when nothing groups."""
+    if batch_max < 2:
+        return None
+    keyed: Dict[tuple, list] = {}
+    for r in _oldest_per_tenant(pending, rotation):
+        t = tenants.get(r.tenant)
+        if not _packable(t):
+            continue
+        k = geometry_key(t.model, r.steps)
+        if k is None:
+            continue
+        keyed.setdefault(k, []).append(r)
+    for group in keyed.values():
+        if len(group) >= 2:
+            return group[:batch_max]
+    return None
+
+
+class BatchExecutor:
+    """Runs a geometry-matched group as ONE dispatch with a leading batch
+    axis, caching the compiled batched callable per (geometry, resolved
+    step, mode).  Results install only on success — an exception leaves
+    every tenant's state untouched for the serial fallback."""
+
+    def __init__(self):
+        self._cache: Dict[tuple, Callable] = {}
+
+    @staticmethod
+    def _resolved(model):
+        """The per-shard callable to batch over: a ladder-backed step
+        batches its CURRENTLY-BUILT rung (so degradation decisions keep
+        applying under batching), a raw jitted step batches itself."""
+        step = model._step
+        ladder = getattr(step, "_resilience", None)
+        return ladder.built() if ladder is not None else step
+
+    def run(self, models: Sequence, steps: int) -> None:
+        from stencil_tpu.ops.stream import (
+            batch_axis_mode,
+            make_batched_dispatch,
+        )
+
+        rep = models[0]
+        fn = self._resolved(rep)
+        mode = batch_axis_mode(rep._step)
+        key = (geometry_key(rep, steps), mode, id(fn))
+        batched = self._cache.get(key)
+        if batched is None:
+            batched = make_batched_dispatch(fn, steps, mode)
+            self._cache[key] = batched
+        names = sorted(rep.dd._curr)
+        # jnp.stack COPIES: the stacked buffer is donated to the dispatch
+        # while every tenant's source buffers stay live (serial fallback)
+        stacked = {
+            n: jnp.stack([m.dd._curr[n] for m in models]) for n in names
+        }
+        out = batched(stacked)
+        for i, m in enumerate(models):
+            m.dd._curr = {n: out[n][i] for n in names}
+            m.dd.mark_shell_stale()
+
+
+# --- the sub-slice bin-packer ------------------------------------------------
+
+
+def plan_subslice_candidates(pending, tenants, rotation):
+    """The oldest queued request of each DISTINCT packable tenant whose
+    model can move meshes (``rebuild_after_reshard``), rotation-fair
+    order; ``None`` unless at least two tenants qualify."""
+    picks = []
+    for r in _oldest_per_tenant(pending, rotation):
+        t = tenants.get(r.tenant)
+        if not _packable(t):
+            continue
+        if not hasattr(t.model, "rebuild_after_reshard"):
+            continue
+        picks.append(r)
+    return picks if len(picks) >= 2 else None
+
+
+def _slice_cost(model, devices, link) -> float:
+    """Modeled shell-exchange seconds/step for ``model`` on ``devices``
+    under a measured ``fabric.link_model`` doc: per mesh axis, two shells
+    of that axis's face area cross the axis's slowest measured link.
+    ``link`` is a doc (uniform fabric), a callable ``devices -> doc``
+    (per-slice measured docs), or ``None`` (no fabric data: every slice
+    prices equal and the greedy order decides)."""
+    doc = link(devices) if callable(link) else link
+    axes = (doc or {}).get("axes") or {}
+    if not axes:
+        return 0.0
+    dd = model.dd
+    size = dd.size()
+    bytes_per_cell = sum(
+        jnp.dtype(dd.field_dtype(h)).itemsize for h in dd._handles
+    )
+    area = {
+        "x": size.y * size.z,
+        "y": size.x * size.z,
+        "z": size.x * size.y,
+    }
+    cost = 0.0
+    for axis, face in area.items():
+        sides = axes.get(axis)
+        if not sides:
+            continue
+        gbps = min(
+            float(s.get("gbps_min", s.get("gbps_med", 0.0)) or 0.0)
+            for s in sides.values()
+        )
+        if gbps <= 0.0:
+            continue
+        cost += (2.0 * face * bytes_per_cell) / (gbps * 1e9)
+    return cost
+
+
+def plan_subslices(entries, fleet, link=None):
+    """Greedy decreasing bin-pack of tenants onto DISJOINT contiguous
+    sub-slices of ``fleet``: the fleet splits into equal contiguous
+    slices (one per tenant), tenants sort by descending state footprint,
+    and each takes the cheapest remaining slice under ``_slice_cost`` —
+    high-traffic shell directions stay on fast links, the measured-QAP
+    analog.  ``entries`` is ``[(request, model), ...]`` (distinct
+    tenants); returns ``[(request, model, slice_devices), ...]`` or
+    ``None`` when the fleet cannot give every tenant a device."""
+    k = min(len(entries), len(fleet))
+    if k < 2:
+        return None
+    entries = list(entries)[:k]
+    width = len(fleet) // k
+    slices = [tuple(fleet[i * width : (i + 1) * width]) for i in range(k)]
+    order = sorted(
+        entries, key=lambda e: footprint_bytes(e[1]), reverse=True
+    )
+    remaining = list(range(k))
+    assigned = []
+    for req, model in order:
+        best = min(
+            remaining, key=lambda i: (_slice_cost(model, slices[i], link), i)
+        )
+        remaining.remove(best)
+        assigned.append((req, model, slices[best]))
+    return assigned
+
+
+def place_subslices(assignments) -> int:
+    """Move each assigned tenant onto its disjoint sub-slice (a no-op
+    when already there): a bounded-staging reshard plus the model's step
+    rebuild.  Placement is all that happens here — the server then
+    dispatches every request through its unchanged serial envelope
+    back-to-back, and async dispatch overlaps the step programs across
+    the disjoint device sets; per-tenant digests stay bitwise-identical
+    to full-fleet serial execution (mesh-shape independence, pinned by
+    the soak's ``subslice`` leg).  A reshard failure restores the
+    tenant's state (domain.py), so the caller can degrade to serial
+    dispatch on whatever mesh each tenant holds.  Returns how many
+    tenants actually moved."""
+    moved = 0
+    for req, model, devices in assignments:
+        current = tuple(sorted(d.id for d in model.dd.mesh.devices.flat))
+        want = tuple(sorted(d.id for d in devices))
+        if current != want:
+            model.dd.reshard(devices=list(devices), source="subslice")
+            model.rebuild_after_reshard()
+            moved += 1
+            log_info(
+                f"serve: packed tenant {req.tenant} onto sub-slice "
+                f"{list(want)}"
+            )
+    return moved

@@ -94,5 +94,15 @@ class BoundedQueue:
             return self._q.popleft()
         return None
 
+    def remove(self, req: Request) -> bool:
+        """Remove a specific queued request (the packed-dispatch planners
+        pick requests by PEEKING — ``peek_all`` — then claim them here);
+        False when it is no longer queued (e.g. shed meanwhile)."""
+        try:
+            self._q.remove(req)
+            return True
+        except ValueError:
+            return False
+
     def peek_all(self) -> List[Request]:
         return list(self._q)

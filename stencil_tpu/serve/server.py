@@ -41,6 +41,7 @@ from stencil_tpu.resilience.taxonomy import (
     OverloadError,
     classify,
 )
+from stencil_tpu.serve import pack
 from stencil_tpu.serve.aot import AOTCache
 from stencil_tpu.serve.queue import BoundedQueue
 from stencil_tpu.serve.request import AdmissionRefused, Request, Response, TenantSpec
@@ -66,6 +67,10 @@ class StencilServer:
         rng: Optional[random.Random] = None,
         flight=None,
         slow_penalty_s: float = 0.25,
+        batch_max: int = 0,
+        subslice: bool = False,
+        fleet=None,
+        link_model=None,
     ):
         self.tenants: Dict[str, Tenant] = {}
         self.queue = BoundedQueue(queue_max)
@@ -80,6 +85,17 @@ class StencilServer:
         self.rng = rng
         self.flight = flight
         self.slow_penalty_s = slow_penalty_s
+        # throughput packing (docs/serving.md "Throughput: batching and
+        # sub-slice packing"): batch_max >= 2 turns batched dispatch on;
+        # subslice turns the bin-packer on; fleet pins the device pool
+        # (derived from the tenants' meshes when None); link_model is the
+        # measured fabric doc (or devices -> doc callable) the packer
+        # scores slices against
+        self.batch_max = int(batch_max)
+        self.subslice = bool(subslice)
+        self.fleet = list(fleet) if fleet is not None else None
+        self.link_model = link_model
+        self._batch_exec = pack.BatchExecutor()
         self._rotation: List[str] = []
         self._builders: Dict[str, Callable] = {}
         self._slow_pending = False
@@ -237,18 +253,36 @@ class StencilServer:
         self._slow_pending = True
 
     def cycle(self) -> List[Response]:
-        """One dispatch cycle: shed expired, serve one request fairly,
-        observe the elasticity policy.  Returns every response produced
-        (shed responses included); empty list = nothing queued."""
+        """One dispatch cycle: shed expired, then serve as much of the
+        queue as one dispatch can carry — a geometry-matched BATCH, a
+        sub-slice PACK, or (the default) one request — and observe the
+        elasticity policy.  Returns every response produced (shed
+        responses included); empty list = nothing queued."""
         now = self.clock()
         out = [self._shed(r, "deadline", now) for r in self.queue.shed_expired(now)]
-        req = self.queue.take(self._rotation)
-        if req is not None:
-            out.append(self._dispatch(req))
-            # rotate AFTER serving: the served tenant goes to the back
-            if req.tenant in self._rotation:
-                self._rotation.remove(req.tenant)
-                self._rotation.append(req.tenant)
+        served: List[str] = []
+        plan = self._plan_packed()
+        if plan is not None:
+            kind, payload = plan
+            if kind == "batched":
+                out.extend(self._dispatch_batched(payload))
+                served = [r.tenant for r in payload]
+            else:
+                out.extend(self._dispatch_subslice(payload))
+                served = [r.tenant for r, _m, _d in payload]
+        else:
+            req = self.queue.take(self._rotation)
+            if req is not None:
+                model = self.tenants[req.tenant].model
+                self._gauge_occupancy(self._model_devices(model))
+                out.append(self._dispatch(req))
+                served = [req.tenant]
+        # rotate AFTER serving: the served tenants go to the back, in
+        # served order, so dispatch slots keep round-robin fairness
+        for tid in served:
+            if tid in self._rotation:
+                self._rotation.remove(tid)
+                self._rotation.append(tid)
         depth = self.queue.depth()
         telemetry.set_gauge(tm.SERVE_QUEUE_DEPTH, depth)
         if self.policy is not None:
@@ -264,6 +298,196 @@ class StencilServer:
                 if self.capacity is not None:
                     self.capacity(kind)
         return out
+
+    # --- packed dispatch (serve/pack.py; docs/serving.md "Throughput") --------
+
+    def _plan_packed(self):
+        """The scheduler policy: a geometry-matched batch wins (one
+        dispatch, N tenants), else a sub-slice pack of >= 2 movable
+        tenants, else None (serial).  Chosen requests are claimed out of
+        the queue before dispatch."""
+        if self.batch_max < 2 and not self.subslice:
+            return None
+        pending = self.queue.peek_all()
+        if len(pending) < 2:
+            return None
+        if self.batch_max >= 2:
+            group = pack.plan_batches(
+                pending, self.tenants, self._rotation, self.batch_max
+            )
+            if group:
+                claimed = [r for r in group if self.queue.remove(r)]
+                if len(claimed) >= 2:
+                    return ("batched", claimed)
+                for r in claimed:  # unreachable in the single-threaded loop
+                    self.queue.push(r, self.clock())
+        if self.subslice:
+            cands = pack.plan_subslice_candidates(
+                pending, self.tenants, self._rotation
+            )
+            if cands:
+                fleet = self._fleet_devices()
+                assignments = pack.plan_subslices(
+                    [(r, self.tenants[r.tenant].model) for r in cands],
+                    fleet,
+                    self.link_model,
+                )
+                if assignments:
+                    claimed = [
+                        a for a in assignments if self.queue.remove(a[0])
+                    ]
+                    if len(claimed) >= 2:
+                        return ("subslice", claimed)
+                    for a in claimed:  # unreachable, as above
+                        self.queue.push(a[0], self.clock())
+        return None
+
+    def _probe_envelope(self, req: Request):
+        """Fire exactly the injected-fault surface a serial dispatch of
+        ``req`` would fire (dispatch hook, then the execute hook under the
+        retry policy, charged to the tenant's budget) WITHOUT running the
+        model — the batched path consumes each member's envelope up front
+        so a seeded fault against one tenant of a batch surfaces before
+        any state is installed.  Returns (attempts, error-or-None)."""
+        tenant = self.tenants[req.tenant]
+        label = f"serve:{req.tenant}"
+        attempts = [0]
+
+        def probe():
+            attempts[0] += 1
+            inject.maybe_fail("execute", label)
+
+        try:
+            inject.maybe_fail("dispatch", label)
+            execute_with_retry(
+                probe,
+                label=label,
+                policy=self.retry_policy,
+                budget=tenant.budget,
+                sleep=self.sleep,
+                rng=self.rng,
+            )
+        except Exception as e:  # noqa: BLE001 — classified by the caller
+            return attempts[0], e
+        tenant.retries += max(0, attempts[0] - 1)
+        return attempts[0], None
+
+    def _dispatch_batched(self, reqs: List[Request]) -> List[Response]:
+        """ONE dispatch for a geometry-matched group: per-member fault
+        envelopes fire first (in queue order); then the stacked states run
+        as one batched program and slice back out.  ANY classified
+        failure — a member's envelope or the batched execution itself —
+        falls the group back to serial re-execution, so isolation
+        semantics (eviction, shedding, budgets) are exactly the serial
+        path's; nothing installs unless the whole batch succeeds."""
+        failed = None
+        for r in reqs:
+            attempts, err = self._probe_envelope(r)
+            if err is not None:
+                failed = (r, err, attempts)
+                break
+        if failed is None:
+            if self._slow_pending:
+                self._slow_pending = False
+                self.sleep(self.slow_penalty_s)
+            models = [self.tenants[r.tenant].model for r in reqs]
+            try:
+                self._batch_exec.run(models, reqs[0].steps)
+            except Exception as e:  # noqa: BLE001 — classified serially below
+                failed = (None, e, 0)
+        if failed is not None:
+            bad, err, attempts = failed
+            telemetry.inc(tm.SERVE_BATCH_FALLBACKS)
+            log_warn(
+                f"serve: batched dispatch of {len(reqs)} requests fell "
+                f"back to serial ({type(err).__name__}: {str(err)[:160]})"
+            )
+            out = []
+            for r in reqs:
+                if r is bad:
+                    out.append(
+                        self._on_dispatch_failure(
+                            r, self.tenants[r.tenant], err, attempts
+                        )
+                    )
+                else:
+                    out.append(self._dispatch(r))
+            return out
+        now = self.clock()
+        telemetry.inc(tm.SERVE_BATCH_DISPATCHES)
+        telemetry.observe(tm.SERVE_BATCH_SIZE, len(reqs))
+        self._gauge_occupancy(
+            self._model_devices(self.tenants[reqs[0].tenant].model)
+        )
+        out = []
+        for r in reqs:
+            tenant = self.tenants[r.tenant]
+            latency = max(0.0, now - r.enqueued_at)
+            tenant.completed += 1
+            tenant.latency.insert(latency)
+            self._completed_total += 1
+            telemetry.inc(tm.SERVE_COMPLETED)
+            telemetry.observe(tm.SERVE_LATENCY_SECONDS, latency)
+            out.append(
+                Response(
+                    request=r, ok=True, latency_s=latency, steps_done=r.steps
+                )
+            )
+        self._heartbeat()
+        return out
+
+    def _dispatch_subslice(self, assignments) -> List[Response]:
+        """Place each tenant on its disjoint sub-slice, then dispatch
+        every request through the UNCHANGED serial envelope back-to-back —
+        async dispatch overlaps the step programs across the disjoint
+        device sets, and every fault/retry/budget semantic is literally
+        the serial path's.  A placement failure (reshard restores state)
+        degrades to serial dispatch on whatever mesh each tenant holds."""
+        try:
+            pack.place_subslices(assignments)
+        except Exception as e:  # noqa: BLE001 — placement only; state restored
+            telemetry.inc(tm.SERVE_BATCH_FALLBACKS)
+            log_warn(
+                f"serve: sub-slice placement of {len(assignments)} tenants "
+                f"fell back to serial ({type(e).__name__}: {str(e)[:160]})"
+            )
+        else:
+            telemetry.inc(tm.SERVE_SUBSLICE_DISPATCHES)
+            telemetry.observe(tm.SERVE_SUBSLICE_COUNT, len(assignments))
+            self._gauge_occupancy(
+                sum(
+                    self._model_devices(m) for _r, m, _d in assignments
+                )
+            )
+        return [self._dispatch(r) for r, _m, _d in assignments]
+
+    @staticmethod
+    def _model_devices(model) -> int:
+        dd = getattr(model, "dd", None)
+        if dd is None or getattr(dd, "mesh", None) is None:
+            return 0
+        return int(dd.mesh.devices.size)
+
+    def _fleet_devices(self) -> list:
+        """The device pool the bin-packer carves: the pinned ``fleet``
+        when given, else the union of the tenants' current meshes."""
+        if self.fleet is not None:
+            return list(self.fleet)
+        seen: Dict[int, object] = {}
+        for t in self.tenants.values():
+            dd = getattr(t.model, "dd", None)
+            if dd is None or getattr(dd, "mesh", None) is None:
+                continue
+            for d in dd.mesh.devices.flat:
+                seen[d.id] = d
+        return [seen[i] for i in sorted(seen)]
+
+    def _gauge_occupancy(self, busy_devices: int) -> None:
+        fleet = len(self._fleet_devices())
+        if fleet > 0:
+            telemetry.set_gauge(
+                tm.SERVE_OCCUPANCY, min(1.0, busy_devices / fleet)
+            )
 
     def _dispatch(self, req: Request) -> Response:
         tenant = self.tenants[req.tenant]
@@ -344,12 +568,21 @@ class StencilServer:
 
     def drain(self, max_cycles: int = 10_000) -> List[Response]:
         """Cycle until the queue is empty (or the cycle bound trips —
-        never an unbounded loop inside a bounded-queue package)."""
+        never an unbounded loop inside a bounded-queue package).  A
+        truncated drain is NOT silent: it logs the bound and the work
+        left behind, and counts ``serve.drain.truncated``."""
         out: List[Response] = []
         for _ in range(max_cycles):
             if self.queue.depth() == 0:
                 break
             out.extend(self.cycle())
+        remaining = self.queue.depth()
+        if remaining > 0:
+            telemetry.inc(tm.SERVE_DRAIN_TRUNCATED)
+            log_warn(
+                f"serve: drain truncated at max_cycles={max_cycles} with "
+                f"{remaining} request(s) still queued"
+            )
         return out
 
     def tenant_table(self) -> List[dict]:

@@ -266,6 +266,17 @@ def entries_from_artifact(path: str) -> List[dict]:
                 better="lower", requests=doc.get("requests"),
             )
         )
+        # aggregate serving throughput (batched/sub-slice packed dispatch
+        # lands here as a rate climb) — HIGHER-is-better, the one serve
+        # series where the gate flags drops
+        tp = doc.get("throughput") or {}
+        out.append(
+            _entry(
+                ts, "serve:throughput", tp.get("requests_per_s"), "1/s",
+                source, mcells_per_s=tp.get("mcells_per_s"),
+                batch_max=tp.get("batch_max"), subslice=tp.get("subslice"),
+            )
+        )
         return [e for e in out if e is not None]
 
     if isinstance(doc, dict) and doc.get("bench") == "exchange":

@@ -125,6 +125,19 @@ SERVE_SHED = "serve.shed"
 SERVE_EVICTED = "serve.evicted"
 #: requests served to completion
 SERVE_COMPLETED = "serve.completed"
+#: ``StencilServer.drain`` runs that hit the ``max_cycles`` bound with
+#: work still queued (no-silent-caps: the truncation also logs the bound
+#: and the remaining depth)
+SERVE_DRAIN_TRUNCATED = "serve.drain.truncated"
+#: packed dispatches (batched group or sub-slice placement) that fell
+#: back to serial re-execution after a classified failure
+SERVE_BATCH_FALLBACKS = "serve.batch.fallbacks"
+#: successful BATCHED dispatches (always-live engagement evidence: the
+#: soak's packed legs assert > 0 — histograms only record with telemetry
+#: enabled, and digest equality alone cannot prove batching ran)
+SERVE_BATCH_DISPATCHES = "serve.batch.dispatches"
+#: successful sub-slice packed cycles (same role for the bin-packer)
+SERVE_SUBSLICE_DISPATCHES = "serve.subslice.dispatches"
 #: analytic bytes moved per exchange over ONE mesh hop — one counter per
 #: (axis, direction) so the comms roofline can price each link of the
 #: realized mesh (the per-direction decomposition of ``domain.exchange.bytes``
@@ -193,6 +206,10 @@ ALL_COUNTERS = frozenset({
     SERVE_SHED,
     SERVE_EVICTED,
     SERVE_COMPLETED,
+    SERVE_DRAIN_TRUNCATED,
+    SERVE_BATCH_FALLBACKS,
+    SERVE_BATCH_DISPATCHES,
+    SERVE_SUBSLICE_DISPATCHES,
     EXCHANGE_HOP_X_LOW_BYTES,
     EXCHANGE_HOP_X_HIGH_BYTES,
     EXCHANGE_HOP_Y_LOW_BYTES,
@@ -217,12 +234,17 @@ CHECKPOINT_RETAINED = "checkpoint.retained"
 SERVE_QUEUE_DEPTH = "serve.queue.depth"
 #: tenants currently in the "active" state (admitted, not quarantined)
 SERVE_TENANTS_ACTIVE = "serve.tenants.active"
+#: fraction of the fleet's devices busy in the most recent dispatch
+#: (1.0 = a full-fleet or batched dispatch; a sub-slice pack sums its
+#: disjoint slices — the throughput scheduler's utilization signal)
+SERVE_OCCUPANCY = "serve.occupancy"
 
 ALL_GAUGES = frozenset({
     EXCHANGE_BYTES_PER_EXCHANGE,
     CHECKPOINT_RETAINED,
     SERVE_QUEUE_DEPTH,
     SERVE_TENANTS_ACTIVE,
+    SERVE_OCCUPANCY,
 })
 
 # --- histograms (Statistics-backed: min/max/avg/stddev/med/trimean) ----------
@@ -257,6 +279,12 @@ SERVE_LATENCY_SECONDS = "serve.latency.seconds"
 #: wall seconds per AOT executable compile at admission (serve/aot.py —
 #: the cost the admission budget bounds)
 SERVE_COMPILE_SECONDS = "serve.compile.seconds"
+#: requests carried per BATCHED dispatch (serve/pack.py — geometry-matched
+#: groups stacked along a leading batch axis into one dispatch)
+SERVE_BATCH_SIZE = "serve.batch.size"
+#: tenants packed per sub-slice dispatch cycle (disjoint sub-meshes of
+#: the fleet executing concurrently)
+SERVE_SUBSLICE_COUNT = "serve.subslice.count"
 #: measured point-to-point link bandwidth over the realized mesh, GB/s per
 #: probed neighbor edge (telemetry/fabric.py — the NVML-distance-matrix
 #: analog feeding the comms roofline)
@@ -276,6 +304,8 @@ ALL_HISTOGRAMS = frozenset({
     NUMERICS_SNAPSHOT_SECONDS,
     SERVE_LATENCY_SECONDS,
     SERVE_COMPILE_SECONDS,
+    SERVE_BATCH_SIZE,
+    SERVE_SUBSLICE_COUNT,
     FABRIC_LINK_GBPS,
     FABRIC_PROBE_SECONDS,
 })

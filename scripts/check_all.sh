@@ -36,7 +36,15 @@ else
 fi
 
 echo "== stencil-analysis (program contracts) ==" >&2
-python -m stencil_tpu.analysis || rc=1
+# On failure, re-run WITH the per-contract timing table (--timings) so the
+# failing invocation also reports where the verification budget went —
+# traced programs are memoized per-process, so the rerun re-traces; keep
+# it to the failure path to hold the green-path gate one-shot.
+if ! python -m stencil_tpu.analysis; then
+  rc=1
+  echo "== stencil-analysis per-contract timings (failed run) ==" >&2
+  python -m stencil_tpu.analysis --timings >/dev/null || true
+fi
 
 if [ "$CHANGED_ONLY" = 0 ]; then
   echo "== tier-1 tests ==" >&2

@@ -65,6 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true", help="machine-readable output on stdout"
     )
     p.add_argument(
+        "--timings",
+        action="store_true",
+        help="print a per-contract wall-time summary on stderr (always "
+        "included in --json as contract_seconds)",
+    )
+    p.add_argument(
         "--list-contracts",
         action="store_true",
         help="print the contract catalog (id + rationale) and exit",
@@ -137,9 +143,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # a failure INSIDE the canonical builds is a real break, not a
         # usage error — let it traceback instead of masking it as exit 2
         artifacts = build_matrix(labels=args.program)
-    findings = framework.check_artifacts(artifacts, select=select)
+    timings = {}
+    findings = framework.check_artifacts(
+        artifacts, select=select, timings=timings
+    )
+    if args.timings:
+        framework.render_timings(timings)
     if args.json:
-        print(framework.render_json(findings, programs=len(artifacts)))
+        print(
+            framework.render_json(
+                findings, programs=len(artifacts), timings=timings
+            )
+        )
     else:
         framework.render_human(findings)
         if not findings:

@@ -44,6 +44,21 @@ walker or a source-level heuristic the tracer can defeat:
   program is a registered span (``telemetry/names.py ALL_SPANS``): drift
   the source-level ``span-name`` rule cannot see through f-strings or
   indirection falls out of device-time attribution silently.
+* ``kernel-race``         — the kernel verifier's deliberate descent
+  (``analysis/kernels.py``): no two PARALLEL grid points of any pallas
+  call write the same output block unless the writes are provably
+  identical; sequential grids keep their last-write-wins replays.
+* ``kernel-coverage``     — every output block of every pallas call is
+  written by some grid point or carried in via a shape-and-dtype-
+  consistent ``input_output_aliases`` entry (the donation-soundness
+  analog one level down); boundary shells up to the plan's depth margin
+  are the one sanctioned gap.
+* ``tiling-legal``        — the Mosaic tiling-legality model over the
+  traced kernels: no rotate on unaligned or non-32-bit planes, no
+  blocked windows at sub-granule offsets, no int64 index arithmetic —
+  the static form of PR 6's COMPILE_REJECT runtime rejections
+  (``analysis/kernels.py``; ``check_kernel_legal`` is the same verdict
+  pre-build for the tuner and the stream ladder).
 """
 
 from __future__ import annotations
@@ -883,6 +898,76 @@ class VmemBudget(Contract):
         if reason is not None:
             return [art.finding(self.name, reason)]
         return []
+
+
+@register
+class KernelRace(Contract):
+    name = "kernel-race"
+    why = (
+        "no two grid points that differ in a declared-parallel grid dim "
+        "may write the same output block of a pallas call unless the "
+        "writes are provably identical — parallel dims leave the order "
+        "unspecified, so an overlap is a silent value race on chip "
+        "(sequential grids keep their deliberate last-write-wins replays; "
+        "analysis/kernels.py)"
+    )
+
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        return art.closed is not None
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import kernels
+
+        return [
+            art.finding(self.name, msg) for msg in kernels.check_races(art)
+        ]
+
+
+@register
+class KernelCoverage(Contract):
+    name = "kernel-coverage"
+    why = (
+        "every output block of every pallas call is written by some grid "
+        "point or carried in via input_output_aliases — whose in/out "
+        "shape-and-dtype consistency is checked too (the donation-"
+        "soundness analog one level down); an unwritten block past the "
+        "plan's shell margin ships uninitialized VMEM to HBM "
+        "(analysis/kernels.py)"
+    )
+
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        return art.closed is not None
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import kernels
+
+        return [
+            art.finding(self.name, msg)
+            for msg in kernels.check_coverage(art)
+        ]
+
+
+@register
+class TilingLegal(Contract):
+    name = "tiling-legal"
+    why = (
+        "every traced pallas kernel survives the Mosaic tiling-legality "
+        "model — no rotate on unaligned or non-32-bit planes, no blocked "
+        "windows at sub-granule offsets, no int64 index arithmetic: the "
+        "static form of the COMPILE_REJECT runtime failures PR 6 ate "
+        "(analysis/kernels.py; the tuner and the stream ladder consult "
+        "the same verdict pre-build via check_kernel_legal)"
+    )
+
+    def applies_to(self, art: ProgramArtifact) -> bool:
+        return art.closed is not None
+
+    def check(self, art: ProgramArtifact) -> List[Finding]:
+        from stencil_tpu.analysis import kernels
+
+        return [
+            art.finding(self.name, msg) for msg in kernels.check_tiling(art)
+        ]
 
 
 @register

@@ -23,7 +23,8 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Callable, Iterable, List, Optional, Sequence
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 
 @dataclasses.dataclass(frozen=True)
@@ -176,27 +177,38 @@ def check(
     artifact: ProgramArtifact,
     contract: Optional[str] = None,
     select: Optional[Iterable[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Finding]:
     """Run contracts over ONE artifact.  ``contract=`` selects a single id
     (the ported structural tests' entry point); ``select=`` a list; both
-    None runs every registered contract that applies."""
+    None runs every registered contract that applies.  ``timings=`` is an
+    out-param dict accumulating per-contract wall seconds."""
     if contract is not None:
         select = [contract]
     out: List[Finding] = []
     for c in _select(select):
-        if c.applies_to(artifact):
-            out.extend(c.check(artifact))
+        if not c.applies_to(artifact):
+            continue
+        t0 = time.perf_counter()
+        out.extend(c.check(artifact))
+        if timings is not None:
+            timings[c.name] = (
+                timings.get(c.name, 0.0) + time.perf_counter() - t0
+            )
     return sorted(out, key=lambda f: (f.program, f.contract, f.message))
 
 
 def check_artifacts(
     artifacts: Sequence[ProgramArtifact],
     select: Optional[Iterable[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Finding]:
-    """Run contracts over a whole artifact set (the canonical matrix)."""
+    """Run contracts over a whole artifact set (the canonical matrix).
+    ``timings=`` accumulates wall seconds per contract id across the set —
+    the CLI's ``--timings`` summary and ``--json`` ``contract_seconds``."""
     out: List[Finding] = []
     for art in artifacts:
-        out.extend(check(art, select=select))
+        out.extend(check(art, select=select, timings=timings))
     return out
 
 
@@ -212,13 +224,20 @@ def applied_contracts(artifacts: Sequence[ProgramArtifact]) -> List[str]:
     return sorted(out)
 
 
-def render_json(findings: List[Finding], programs: int) -> str:
+def render_json(
+    findings: List[Finding],
+    programs: int,
+    timings: Optional[Dict[str, float]] = None,
+) -> str:
     return json.dumps(
         {
             "findings": [f.as_json() for f in findings],
             "count": len(findings),
             "programs_checked": programs,
             "contracts": sorted(c.name for c in all_contracts()),
+            "contract_seconds": {
+                k: round(v, 4) for k, v in sorted((timings or {}).items())
+            },
         },
         indent=2,
         sort_keys=True,
@@ -233,3 +252,14 @@ def render_human(findings: List[Finding], stream=None) -> None:
         print(f.render(), file=stream)
     if findings:
         print(f"{len(findings)} program-contract finding(s)", file=stream)
+
+
+def render_timings(timings: Dict[str, float], stream=None) -> None:
+    """Per-contract wall-time summary, slowest first (``--timings``; the
+    one-shot gate surfaces this on failure so a matrix-growth slowdown is
+    attributable to a contract, not a mystery)."""
+    import sys
+
+    stream = stream or sys.stderr
+    for name, secs in sorted(timings.items(), key=lambda kv: -kv[1]):
+        print(f"{secs:8.3f}s  {name}", file=stream)

@@ -16,6 +16,10 @@ Three tools, shared by every contract (``analysis/contracts.py``):
   is opaque by default: a pallas kernel's inner jaxpr describes VMEM-ref
   mutation, not array dataflow, and a contract scanning for e.g. big-array
   dynamic-update-slices must not mistake a tile-local ref update for one.
+  The opacity is a TAINT-analysis stance, not ignorance: the kernel
+  verifier (``analysis/kernels.py``) descends into pallas bodies
+  deliberately, through the call's own metadata (grid, BlockSpec index
+  maps, aliases) where the questions ARE kernel-level.
 * :func:`taint_rows` — var-level forward taint/reachability inside one
   jaxpr: which eqns transitively consume a source primitive's outputs.
   Opaque eqns (pallas calls, custom calls) are treated CONSERVATIVELY:

@@ -60,3 +60,44 @@ CANONICAL_AXES = {
         "covered": ("batched", "subslice"),
     },
 }
+
+#: kernel-coverage ledger — the ``contract-coverage`` pattern one level
+#: down: every top-level ops/ function that issues a ``pallas_call`` must
+#: be named here, per defining module, so the kernel verifier's sweep
+#: (``analysis/kernels.py``; contracts ``kernel-race``/``kernel-coverage``/
+#: ``tiling-legal``) has a statically-checkable inventory of the pallas
+#: box it is expected to open.  The ``kernel-ledger`` lint rule
+#: (``lint/rules/kernel_ledger.py``) fails any ops/ module that grows a
+#: kernel without growing this ledger; the kernels themselves are reached
+#: through the canonical matrix (``analysis/programs.py``) plus the
+#: fixture corpus (``tests/analysis_fixtures/``).
+PALLAS_KERNELS = {
+    "stencil_tpu/ops/halo_blend.py": (
+        "blend_slab",
+        "blend_slab_dynamic",
+    ),
+    "stencil_tpu/ops/jacobi_pallas.py": (
+        "jacobi_wrap_step",
+        "jacobi_shell_wavefront_step",
+        "jacobi_zring_wavefront_step",
+        "jacobi_slab_step",
+        "jacobi_plane_step",
+    ),
+    "stencil_tpu/ops/pack.py": (
+        "pallas_pack_slab",
+        "pallas_unpack_slab",
+        "pack_zshell_pallas",
+        "unpack_zshell_pallas",
+        "pack_yshell_pallas",
+        "unpack_yshell_pallas",
+    ),
+    "stencil_tpu/ops/plane_stencil.py": (
+        "mean6_shell_wavefront_step",
+        "mean6_plane_step",
+    ),
+    "stencil_tpu/ops/stream.py": (
+        "stream_plane_pass",
+        "stream_wavefront_pass",
+        "stream_wrap_pass",
+    ),
+}

@@ -13,6 +13,7 @@ from stencil_tpu.lint.rules import (  # noqa: F401
     donation,
     env_reads,
     jax_free,
+    kernel_ledger,
     layout_traps,
     serve_invariants,
     span_name,

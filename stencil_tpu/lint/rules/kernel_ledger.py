@@ -1,0 +1,83 @@
+"""Rule ``kernel-ledger``: a pallas kernel added under ``ops/`` must be
+named in the kernel-coverage ledger (``PALLAS_KERNELS`` in
+``stencil_tpu/analysis/registry.py``) — the ``contract-coverage`` pattern
+one level down.
+
+Why: the kernel verifier (``analysis/kernels.py``; contracts
+``kernel-race``/``kernel-coverage``/``tiling-legal``,
+docs/static-analysis.md "Kernel verifier") descends into every pallas call
+the canonical matrix traces, but a NEW kernel the matrix never reaches is
+an unverified write surface: its grid could race, its block maps could
+leave output gaps, its shapes could be Mosaic-illegal — exactly the
+failure classes the verifier exists to make static.  This rule fails the
+defining module until the jax-free ledger — which
+``tests/test_analysis.py::test_kernel_ledger_matches_tree`` pins against
+the real tree in both directions — names every top-level function that
+issues a ``pallas_call``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from stencil_tpu.lint.framework import FileContext, Rule, Violation, register
+
+
+def _ledger():
+    """The jax-free kernel ledger — imported lazily (the registry module
+    never touches jax; the lint run stays milliseconds)."""
+    from stencil_tpu.analysis.registry import PALLAS_KERNELS
+
+    return PALLAS_KERNELS
+
+
+def _issues_pallas_call(node: ast.FunctionDef) -> bool:
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        fn = sub.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "pallas_call":
+            return True
+        if isinstance(fn, ast.Name) and fn.id == "pallas_call":
+            return True
+    return False
+
+
+@register
+class KernelLedgerRule(Rule):
+    name = "kernel-ledger"
+    why = (
+        "an ops/ function issuing a pallas_call must be named in the "
+        "kernel-coverage ledger (analysis/registry.py PALLAS_KERNELS) — "
+        "new kernels cannot ship outside the kernel verifier's sweep"
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.replace("\\", "/").startswith("stencil_tpu/ops/")
+
+    def check(self, ctx: FileContext) -> List[Violation]:
+        ledger = _ledger()
+        rel = ctx.rel.replace("\\", "/")
+        named = ledger.get(rel, ())
+        out: List[Violation] = []
+        for node in ctx.tree.body:  # top level only: helpers that build a
+            # pallas_call for an enclosing kernel fn are that kernel's body
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if not _issues_pallas_call(node):
+                continue
+            if node.name in named:
+                continue
+            out.append(
+                ctx.violation(
+                    self.name,
+                    node,
+                    f"{node.name} issues a pallas_call but is not in the "
+                    f"kernel-coverage ledger for {rel} — add it to "
+                    "PALLAS_KERNELS in stencil_tpu/analysis/registry.py "
+                    "(and reach it from the canonical matrix or the "
+                    "fixture corpus) before shipping the kernel",
+                )
+            )
+        return out

@@ -2145,15 +2145,19 @@ def make_stream_step(
         p2["mxu_input_forced"] = True
         return rung_for(p2)
 
-    # static VMEM prefilter (analysis/vmem.py): on real backends a rung the
-    # model already rejects descends WITHOUT compiling — the mxu twin's
-    # resident band matrices are the case plan_stream's depth gate never
-    # modeled, previously a compile-and-catch VMEM_OOM.  Interpret mode has
-    # no Mosaic and nothing to budget, so the model must not veto there.
+    # static prefilters on real backends: a rung the VMEM model
+    # (analysis/vmem.py) already rejects descends WITHOUT compiling — the
+    # mxu twin's resident band matrices are the case plan_stream's depth
+    # gate never modeled, previously a compile-and-catch VMEM_OOM — and a
+    # rung the Mosaic legality model (analysis/kernels.py) rejects
+    # descends as a recorded COMPILE_REJECT the same way (the tuple
+    # verdict names the class).  Interpret mode has no Mosaic: nothing to
+    # budget, nothing to lower, the models must not veto there.
     prefilter = None
     if not interpret:
         def prefilter(rung):
-            from stencil_tpu.analysis import check_vmem
+            from stencil_tpu.analysis import check_kernel_legal, check_vmem
+            from stencil_tpu.resilience.taxonomy import FailureClass
 
             # model what build() will actually compile: the unit resolves
             # through the same chain the build uses (_prospective_unit —
@@ -2161,7 +2165,13 @@ def make_stream_step(
             # structurally degrades to vpu must NOT be priced as mxu)
             p = dict(rung.state["plan"])
             p["compute_unit"] = _prospective_unit(p)
-            return check_vmem(dd, p)
+            reason = check_vmem(dd, p)
+            if reason is not None:
+                return reason
+            reason = check_kernel_legal(dd, p)
+            if reason is not None:
+                return (reason, FailureClass.COMPILE_REJECT)
+            return None
 
     ladder = DegradationLadder(
         rung_for(plan), lower=lower, label="stream", prefilter=prefilter

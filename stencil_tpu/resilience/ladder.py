@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 from stencil_tpu import telemetry
 from stencil_tpu.resilience import inject
@@ -71,7 +71,9 @@ class DegradationLadder:
         label: str = "step",
         eager_build: bool = True,
         buffers: Optional[Callable[[], Any]] = None,
-        prefilter: Optional[Callable[[Rung], Optional[str]]] = None,
+        prefilter: Optional[
+            Callable[[Rung], Union[None, str, Tuple[str, FailureClass]]]
+        ] = None,
     ):
         self.label = label
         self.rung = first
@@ -79,7 +81,10 @@ class DegradationLadder:
         # a STATIC reject — ``prefilter(rung)`` returning a reason string
         # descends without ever compiling (the analysis VMEM model's
         # verdict, stencil_tpu/analysis/vmem.py): the compile-and-catch
-        # VMEM_OOM becomes a zero-cost descent.  None = rung may build.
+        # VMEM_OOM becomes a zero-cost descent.  A ``(reason, FailureClass)``
+        # tuple names the class explicitly — the kernel legality model
+        # (stencil_tpu/analysis/kernels.py) records COMPILE_REJECT descents
+        # the same way.  None = rung may build.
         self._prefilter = prefilter
         # the arrays whose liveness gates a re-invocation; defaults to the
         # step call's own args (call sites whose donated buffers live
@@ -109,17 +114,23 @@ class DegradationLadder:
 
     def _apply_prefilter(self) -> None:
         """Descend past every rung the static prefilter rejects — recorded
-        as a VMEM_OOM descent (it IS the VMEM model's verdict), with no
+        as the verdict's failure class (a bare reason string is the VMEM
+        model's verdict, VMEM_OOM; a ``(reason, FailureClass)`` tuple names
+        its class — COMPILE_REJECT for the kernel legality model), with no
         compile attempted.  An exhausted ladder raises the reject."""
         if self._prefilter is None:
             return
         while True:
-            reason = self._prefilter(self.rung)
-            if reason is None:
+            verdict = self._prefilter(self.rung)
+            if verdict is None:
                 return
+            if isinstance(verdict, tuple):
+                reason, cls = verdict
+            else:
+                reason, cls = verdict, FailureClass.VMEM_OOM
             exc = RuntimeError(f"statically prefiltered: {reason}")
             failed = self.rung.name
-            if not self._descend(FailureClass.VMEM_OOM, exc):
+            if not self._descend(cls, exc):
                 raise exc
             from stencil_tpu.utils.logging import log_warn
 

@@ -424,15 +424,17 @@ def stream_space(dd, x_radius: int, separable: bool, static_plan: dict,
             prefiltered += 1
     else:
         prefiltered += 2
-    # static VMEM verdict (analysis/vmem.py): candidates whose MODELED
-    # footprint busts the scoped-VMEM budget are pruned here, before the
-    # search pays a compile-and-catch VMEM_OOM for them.  plan_stream
-    # already depth-gates the vpu plans through the same model, so this
-    # mostly catches the twins the planner never modeled — the mxu twin's
-    # resident band matrices foremost.  The static pick always survives
-    # (it IS the no-tune fallback being defended), matching the wrap
-    # space's rule.
-    from stencil_tpu.analysis import check_vmem
+    # static verdicts: candidates whose MODELED footprint busts the
+    # scoped-VMEM budget (analysis/vmem.py), or whose kernels the Mosaic
+    # legality model rejects (analysis/kernels.py — x64 index arithmetic,
+    # rotate operand width, sub-granule block windows), are pruned here,
+    # before the search pays a compile-and-catch VMEM_OOM/COMPILE_REJECT
+    # for them.  plan_stream already depth-gates the vpu plans through the
+    # VMEM model, so that leg mostly catches the twins the planner never
+    # modeled — the mxu twin's resident band matrices foremost.  The
+    # static pick always survives (it IS the no-tune fallback being
+    # defended), matching the wrap space's rule.
+    from stencil_tpu.analysis import check_kernel_legal, check_vmem
 
     kept = []
     for c in cands:
@@ -443,7 +445,10 @@ def stream_space(dd, x_radius: int, separable: bool, static_plan: dict,
             and c.get("halo", "array") == "array"
             and c.get("compute_unit", "vpu") == "vpu"
         )
-        if not is_static and check_vmem(dd, c) is not None:
+        if not is_static and (
+            check_vmem(dd, c) is not None
+            or check_kernel_legal(dd, c) is not None
+        ):
             prefiltered += 1
         else:
             kept.append(c)

@@ -1,0 +1,44 @@
+# analysis-fixture: contract=kernel-coverage expect=clean
+"""The two sanctioned coverage stories in one program: output 0 is fully
+written (every x-block visited by the grid), and a second pallas call
+writes only half its output but carries the rest in through a shape-and-
+dtype-consistent ``input_output_aliases`` — the donated buffer keeps its
+prior contents wherever the grid never lands, exactly how the aliased
+wavefront ring updates in place."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def build():
+    def step(b):
+        full = pl.pallas_call(
+            _copy_kernel,
+            grid=(8,),
+            in_specs=[pl.BlockSpec((1, 8, 128), lambda i: (i // 2, 0, 0))],
+            out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((8, 8, 128), jnp.float32),
+            interpret=True,
+        )(b)
+        carried = pl.pallas_call(
+            _copy_kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((8, 8, 128), jnp.float32),
+            input_output_aliases={0: 0},
+            interpret=True,
+        )(full)
+        return carried
+
+    b = jax.ShapeDtypeStruct((4, 8, 128), jnp.float32)
+    return analysis.trace_artifact(
+        step, b, label="fixture:kernel-coverage-clean", kind="fn"
+    )

@@ -1,0 +1,34 @@
+# analysis-fixture: contract=kernel-coverage expect=fire
+"""A block-map coverage gap: the output holds 8 x-blocks but the grid only
+visits 4 (``lambda i: (i, 0, 0)`` over ``grid=(4,)``), no
+``input_output_aliases`` carries the rest in, and the artifact claims no
+shell margin — blocks 4..7 are returned uninitialized (whatever the
+out-buffer allocation held).  The classic symptom downstream is
+nondeterministic garbage in the un-streamed tail."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def build():
+    def step(b):
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((8, 8, 128), jnp.float32),
+            interpret=True,
+        )(b)
+
+    b = jax.ShapeDtypeStruct((4, 8, 128), jnp.float32)
+    return analysis.trace_artifact(
+        step, b, label="fixture:kernel-coverage-fire", kind="fn"
+    )

@@ -1,0 +1,34 @@
+# analysis-fixture: contract=kernel-race expect=clean
+"""The sanctioned revisit: the SAME colliding output map as the fire
+fixture (two grid points write block ``i // 2``), but on a sequential grid
+(no ``dimension_semantics`` — TPU grids default to "arbitrary", i.e.
+in-order).  Every streaming kernel in ops/ relies on this last-write-wins
+replay (the wrap pass revisits ``(i - k) % X``, the wavefront clamps
+``max(i - m, 0)``), so the contract must stay quiet here."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def build():
+    def step(b):
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i // 2, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((2, 8, 128), jnp.float32),
+            interpret=True,
+        )(b)
+
+    b = jax.ShapeDtypeStruct((4, 8, 128), jnp.float32)
+    return analysis.trace_artifact(
+        step, b, label="fixture:kernel-race-clean", kind="fn"
+    )

@@ -1,0 +1,34 @@
+# analysis-fixture: contract=tiling-legal expect=clean
+"""The sanctioned shapes: a natively-tiled (8, 128)-aligned f32 plane
+rotated by a STATIC amount (both lane and sublane extents on the granule —
+the guard PERF_NOTES pins as "shard x-extent % 128 == 0"), streamed
+through full-extent single windows.  Every leg of the legality model is
+exercised and satisfied."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+from stencil_tpu import analysis
+
+
+def _rot_kernel(x_ref, o_ref):
+    o_ref[...] = pltpu.roll(x_ref[...], 3, 1)
+
+
+def build():
+    def step(b):
+        return pl.pallas_call(
+            _rot_kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((1, 16, 256), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, 16, 256), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((4, 16, 256), jnp.float32),
+            interpret=True,
+        )(b)
+
+    b = jax.ShapeDtypeStruct((4, 16, 256), jnp.float32)
+    return analysis.trace_artifact(
+        step, b, label="fixture:tiling-legal-clean", kind="fn"
+    )

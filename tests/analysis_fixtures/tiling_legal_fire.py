@@ -1,0 +1,42 @@
+# analysis-fixture: contract=tiling-legal expect=fire
+"""PR-6 Mosaic regression #1: the shell-padded unaligned rotate.  A
+132x132 f32 plane (a 128-point domain plus a 2-cell shell each side) is
+rotated in-kernel by a TRACED amount — on hardware Mosaic rejects the
+lowering with::
+
+    Mosaic failed to compile TPU kernel: unsupported unaligned shape
+
+(the ``tpu.dynamic_rotate`` wording pinned in PERF_NOTES.md "Mosaic limits
+hit" and classified COMPILE_REJECT by ``resilience/taxonomy.py``).  132 is
+neither lane-aligned (%% 128) nor sublane-aligned (%% 8), and a traced
+amount has no two-slices+concatenate fallback
+(``ops/jacobi_pallas._make_roll`` only rewrites STATIC amounts) — so the
+kernel verifier must reject it statically, before any compile attempt."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+from stencil_tpu import analysis
+
+
+def _rot_kernel(x_ref, o_ref):
+    o_ref[...] = pltpu.roll(x_ref[...], pl.program_id(0), 1)
+
+
+def build():
+    def step(b):
+        return pl.pallas_call(
+            _rot_kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((1, 132, 132), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, 132, 132), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((4, 132, 132), jnp.float32),
+            interpret=True,
+        )(b)
+
+    b = jax.ShapeDtypeStruct((4, 132, 132), jnp.float32)
+    return analysis.trace_artifact(
+        step, b, label="fixture:tiling-legal-rotate-fire", kind="fn"
+    )

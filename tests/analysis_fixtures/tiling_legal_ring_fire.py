@@ -1,0 +1,42 @@
+# analysis-fixture: contract=tiling-legal expect=fire
+"""PR-6 Mosaic regression #2: the 6-sublane ring window.  A ring buffer is
+streamed through BlockSpec windows of 6 sublane rows — ``(4, 12, 256)``
+blocked ``(1, 6, 256)`` puts the second window at sublane offset 6, off
+the (8, 128) f32 tile grid, and on hardware Mosaic rejects the lowering
+with::
+
+    Mosaic failed to compile TPU kernel: invalid offsets in tiling target
+
+(classified COMPILE_REJECT by ``resilience/taxonomy.py``).  Extent-1
+windows are the legal degenerate stream (the pack kernels' idiom) and a
+single narrow block has no second offset — only this MULTI-ROW sub-granule
+window grid straddles tile rows, which is exactly what the verifier's
+window leg pins.  The fix on hardware was granule-padding the ring rows
+to 8."""
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+
+from stencil_tpu import analysis
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def build():
+    def step(b):
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=(4, 2),
+            in_specs=[pl.BlockSpec((1, 6, 256), lambda i, j: (i, j, 0))],
+            out_specs=pl.BlockSpec((1, 6, 256), lambda i, j: (i, j, 0)),
+            out_shape=jax.ShapeDtypeStruct((4, 12, 256), jnp.float32),
+            interpret=True,
+        )(b)
+
+    b = jax.ShapeDtypeStruct((4, 12, 256), jnp.float32)
+    return analysis.trace_artifact(
+        step, b, label="fixture:tiling-legal-ring-fire", kind="fn"
+    )

@@ -1,0 +1,21 @@
+# lint-fixture: select=kernel-ledger rel=stencil_tpu/ops/pack.py expect=clean
+# The sanctioned pattern: every top-level pallas kernel is named in the
+# kernel-coverage ledger (PALLAS_KERNELS in analysis/registry.py) for its
+# module; nested helper lambdas and non-pallas functions are out of scope.
+
+
+def pack_zshell_pallas(block, z0, depth, interpret=False):
+    from jax.experimental import pallas as pl
+
+    def kernel(src_ref, out_ref):
+        out_ref[...] = src_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(depth,),
+        interpret=interpret,
+    )(block)
+
+
+def zshell_buffer_shape(block_shape, depth):
+    return (depth, block_shape[1], block_shape[0])

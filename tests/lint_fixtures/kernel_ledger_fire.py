@@ -1,0 +1,31 @@
+# lint-fixture: select=kernel-ledger rel=stencil_tpu/ops/pack.py expect=kernel-ledger,kernel-ledger,bad-suppression
+# Seeded violations: a new pallas kernel shipped outside the kernel-coverage
+# ledger (PALLAS_KERNELS names no `pack_diag_pallas` for ops/pack.py); a
+# reasoned suppression silences a second; a bare suppression is itself a
+# violation and silences nothing — the kernel under it still fires.
+
+
+def pack_diag_pallas(block, depth):
+    from jax.experimental import pallas as pl
+
+    def kernel(src_ref, out_ref):
+        out_ref[...] = src_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(depth,),
+    )(block)
+
+
+# stencil-lint: disable=kernel-ledger fixture: prototype kernel behind a feature gate, ledger entry lands with the route PR
+def pack_antidiag_pallas(block, depth):
+    import jax.experimental.pallas as pl
+
+    return pl.pallas_call(lambda s, o: None, grid=(depth,))(block)
+
+
+# stencil-lint: disable=kernel-ledger
+def pack_experimental_pallas(block):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(lambda s, o: None, grid=(1,))(block)

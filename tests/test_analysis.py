@@ -4,9 +4,11 @@ The tentpole gate: every registered contract over the whole canonical
 route × overlap × compute-unit × storage-dtype matrix of REALLY built
 programs (interpret/CPU mode) — plus the fixture corpus proving each
 contract fires on a seeded violation and stays quiet on the sanctioned
-pattern, the coverage-ledger pin, analyzer robustness (nested loop bodies,
-donated buffers, pallas opacity), and the static-VMEM prune pins (the
-tune space's zero-compile prune and the ladder's prefilter descent).
+pattern, the coverage-ledger pins (axis matrix AND pallas-kernel ledger),
+analyzer robustness (nested loop bodies, donated buffers, the pallas
+opacity/kernel-verifier split), and the static prune pins (the tune
+space's zero-compile VMEM and Mosaic-legality prunes and the ladder's
+prefilter descents, VMEM_OOM and COMPILE_REJECT alike).
 """
 
 import glob
@@ -137,10 +139,19 @@ def test_cli_json_shape(capsys):
         ["--fixture", fire, "--select", "span-registry", "--json"]
     ) == 1
     doc = json.loads(capsys.readouterr().out)
-    assert set(doc) == {"findings", "count", "programs_checked", "contracts"}
+    assert set(doc) == {
+        "findings",
+        "count",
+        "programs_checked",
+        "contracts",
+        "contract_seconds",
+    }
     assert doc["count"] == len(doc["findings"]) == 1
     assert doc["findings"][0]["contract"] == "span-registry"
     assert sorted(c.name for c in analysis.all_contracts()) == doc["contracts"]
+    # per-contract wall time rides --json: only the selected contract ran
+    assert set(doc["contract_seconds"]) == {"span-registry"}
+    assert doc["contract_seconds"]["span-registry"] >= 0
 
 
 def test_contract_ids_are_kebab_case():
@@ -213,9 +224,13 @@ def test_taint_flows_through_nested_scan_and_while():
 
 
 def test_pallas_opacity_is_conservative():
-    """Taint entering a pallas call flows through to its consumers — an
-    analyzer that descended into the kernel jaxpr (whose ref-mutation vars
-    do not map back) would lose the taint and false-negative here."""
+    """The deliberate split (analysis/jaxpr.py vs analysis/kernels.py):
+    TAINT analysis holds pallas calls opaque-conservative — taint entering
+    a pallas call flows through to its consumers, because the kernel
+    jaxpr's ref-mutation vars do not map back and descending would lose
+    the taint and false-negative here — while the KERNEL verifier descends
+    into the very same calls on purpose, through the call's own metadata
+    (grid, BlockSpec index maps), where the questions are kernel-level."""
     import jax.experimental.pallas as pl
     import numpy as np
     from jax import lax
@@ -246,6 +261,14 @@ def test_pallas_opacity_is_conservative():
     closed = jax.make_jaxpr(fn)(jnp.zeros((8, 16), jnp.float32))
     rows = jx.pallas_taint_rows(closed)
     assert len(rows) == 2 and all(t for _, t in rows), rows
+    # ...and the kernel verifier opens the same two calls it held opaque
+    from stencil_tpu.analysis import kernels as akern
+
+    reports = akern.kernel_reports(closed)
+    assert len(reports) == 2
+    for rep in reports:
+        assert rep.outputs and rep.outputs[0].footprint is not None
+        assert not rep.parallel_dims  # undeclared grids are sequential
 
 
 def test_donation_hazards_on_nested_jit():
@@ -433,6 +456,167 @@ def test_check_vmem_verdicts():
     assert reason is not None and "wavefront[m=2]" in reason
     with pytest.raises(ValueError, match="not a stream plan"):
         analysis.check_vmem(dd, {"route": "warp"})
+
+
+# --- the static Mosaic-legality prune (check_vmem's twin) --------------------
+
+
+def test_check_kernel_legal_verdicts(monkeypatch):
+    """The public legality verdict: the canonical f32 stream plans are
+    legal — including under tier-1's ambient x64, where no Mosaic runs —
+    but in a TPU process with x64 enabled every plan is rejected (Mosaic
+    index arithmetic is 32-bit); a malformed plan raises like
+    check_vmem."""
+    from stencil_tpu.analysis import kernels as akern
+
+    dd = _mk_dd()
+    plan = {"route": "wavefront", "m": 2, "z_slabs": False}
+    with jax.experimental.enable_x64():
+        assert analysis.check_kernel_legal(dd, plan) is None  # CPU: no veto
+        monkeypatch.setattr(akern, "_mosaic_target", lambda: True)
+        reason = analysis.check_kernel_legal(dd, plan)
+        assert reason is not None and "int64" in reason, reason
+    monkeypatch.setattr(akern, "_mosaic_target", lambda: False)
+    assert analysis.check_kernel_legal(dd, plan) is None
+    with pytest.raises(ValueError, match="not a stream plan"):
+        analysis.check_kernel_legal(dd, {"route": "warp"})
+
+
+def test_stream_space_prunes_illegal_kernel_statically(monkeypatch, tune_dir):
+    """tune/space.py consults analysis.check_kernel_legal beside
+    check_vmem: in a TPU process under x64 (Mosaic-illegal index
+    arithmetic for every kernel) the whole non-static space is prefiltered
+    — the static plan alone survives, it being the no-tune fallback under
+    defense."""
+    from stencil_tpu import tune
+    from stencil_tpu.analysis import kernels as akern
+    from stencil_tpu.ops.stream import plan_stream
+    from stencil_tpu.tune import space
+
+    dd = _mk_dd()
+    with tune.disabled():
+        static_plan = plan_stream(dd, 1, "auto", False)
+    cands, prefiltered = space.stream_space(dd, 1, False, static_plan,
+                                            mxu_ok=True)
+    assert len(cands) > 1, "control: the space is non-trivial on CPU"
+    monkeypatch.setattr(akern, "_mosaic_target", lambda: True)
+    with jax.experimental.enable_x64():
+        cands64, prefiltered64 = space.stream_space(
+            dd, 1, False, static_plan, mxu_ok=True
+        )
+    # only the static pick survives (both its alias twins count as static
+    # — alias is excluded from the static-identity comparison)
+    assert len(cands64) < len(cands)
+    skip = ("halo_multiplier", "alias")
+    for c in cands64:
+        assert all(
+            c.get(k) == v for k, v in static_plan.items() if k not in skip
+        ), c
+    assert prefiltered64 >= prefiltered + len(cands) - len(cands64)
+
+
+def test_illegal_candidate_never_compiles(monkeypatch, tune_dir):
+    """The acceptance pin, check_vmem-style: a statically-illegal tuner
+    candidate gets ZERO compile attempts — in a (simulated) TPU process
+    under x64 the build spy sees only the static fallback plan, and the
+    report counts the pruned space."""
+    from stencil_tpu import tune
+    from stencil_tpu.analysis import kernels as akern
+    from stencil_tpu.ops import stream as sm
+    from stencil_tpu.tune.runners import autotune_stream
+
+    dd = _mk_dd()
+    with tune.disabled():
+        static_plan = sm.plan_stream(dd, 1, "auto", False)
+    built_plans = []
+    real_build = sm._build_stream_step
+
+    def spy(dd_, kernel, x_radius, plan, interpret, donate=True,
+            mxu_kernel=None):
+        built_plans.append(dict(plan))
+        return real_build(dd_, kernel, x_radius, plan, interpret,
+                          donate=donate, mxu_kernel=mxu_kernel)
+
+    monkeypatch.setattr(sm, "_build_stream_step", spy)
+    monkeypatch.setattr(akern, "_mosaic_target", lambda: True)
+    with jax.experimental.enable_x64():
+        report = autotune_stream(
+            dd, aprog.mean6_kernel, interpret=True, reps=1, rt=0.0,
+        )
+    assert report.pruned >= 1
+    survivors = {
+        (p["route"], p.get("m"), p.get("compute_unit", "vpu"))
+        for p in built_plans
+    }
+    assert survivors <= {
+        (
+            static_plan["route"],
+            static_plan.get("m"),
+            static_plan.get("compute_unit", "vpu"),
+        )
+    }, built_plans
+
+
+def test_ladder_prefilter_tuple_descends_compile_reject():
+    """resilience/ladder.py: a ``(reason, FailureClass)`` tuple verdict —
+    the kernel legality model's form — descends with the NAMED class
+    recorded (COMPILE_REJECT, not the VMEM_OOM default) and the rejected
+    rung's build never invoked."""
+    from stencil_tpu.resilience.ladder import DegradationLadder, Rung
+    from stencil_tpu.resilience.taxonomy import FailureClass
+
+    calls = []
+
+    def build_a():
+        calls.append("a")
+        return lambda *a: "a"
+
+    def build_b():
+        calls.append("b")
+        return lambda *a: "b"
+
+    a = Rung(name="illegal", build=build_a, state={"legal": False})
+    b = Rung(name="fallback", build=build_b, state={"legal": True})
+
+    ladder = DegradationLadder(
+        a,
+        lower=lambda rung, cls, exc: b if rung is a else None,
+        label="t",
+        prefilter=lambda rung: None
+        if rung.state["legal"]
+        else ("unsupported unaligned shape", FailureClass.COMPILE_REJECT),
+    )
+    assert ladder.step() == "b"
+    assert calls == ["b"], "the rejected rung must never build"
+    assert ladder.descents == [("illegal", FailureClass.COMPILE_REJECT)]
+
+
+def test_kernel_ledger_matches_tree():
+    """The jax-free PALLAS_KERNELS ledger (analysis/registry.py) pins the
+    real tree in BOTH directions: every top-level ops/ function issuing a
+    pallas_call is ledgered, and no ledger entry names a kernel that no
+    longer exists (allowlists must not rot)."""
+    import ast
+
+    from stencil_tpu.lint.rules.kernel_ledger import _issues_pallas_call
+
+    repo = os.path.dirname(HERE)
+    found = {}
+    ops_dir = os.path.join(repo, "stencil_tpu", "ops")
+    for fname in sorted(os.listdir(ops_dir)):
+        if not fname.endswith(".py"):
+            continue
+        rel = f"stencil_tpu/ops/{fname}"
+        with open(os.path.join(ops_dir, fname)) as fh:
+            tree = ast.parse(fh.read())
+        names = tuple(
+            node.name
+            for node in tree.body
+            if isinstance(node, ast.FunctionDef) and _issues_pallas_call(node)
+        )
+        if names:
+            found[rel] = names
+    assert found == dict(aregistry.PALLAS_KERNELS)
 
 
 # --- tier-2: the real CLI end to end -----------------------------------------
